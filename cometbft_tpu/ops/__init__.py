@@ -1,0 +1,6 @@
+"""JAX/Pallas device kernels — the TPU data plane.
+
+Layout convention: field elements are int32 arrays of shape (NLIMBS, B)
+with the *batch* on the trailing axis, so every limb operation is a wide
+vector op across TPU lanes and carry chains walk the (small) leading axis.
+"""

@@ -1,0 +1,277 @@
+"""GF(2^255 - 19) arithmetic in JAX, vectorized over a trailing batch axis.
+
+Representation: little-endian base-2^12 limbs in int32, shape (22, B).
+p = 2^255 - 19; 22 * 12 = 264 bits, so 2^264 = 2^9 * 2^255 = 512 * (p + 19)
+=> 2^264 ≡ 512 * 19 = 9728 (mod p), the carry-fold constant.
+
+Invariant "loose": every limb in [0, 2^13). Products of two loose elements
+sum at most 22 * (2^13 - 1)^2 < 2^31, so schoolbook multiplication never
+overflows int32. `carry()` restores looseness; `freeze()` produces the
+canonical representative (limbs < 2^12, value < p) for comparisons.
+
+Why 12-bit limbs (not 16 or 25.5): the TPU VPU has int32 multiply but no
+native 64-bit accumulate, so limb products plus their 22-term accumulation
+must stay inside int32. 12-bit limbs leave 5 bits of headroom, which keeps
+the loose/carry bound analysis simple and branch-free.
+
+Design (not a port): the reference delegates all of this to
+curve25519-voi's amd64 assembly (reference: go.mod:55,
+crypto/ed25519/ed25519.go:13); we re-derive it for int32 SIMD lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 22
+BITS = 12
+MASK = (1 << BITS) - 1
+FOLD = 9728  # 2^264 mod p
+P_INT = 2**255 - 19
+
+# p in base-2^12 limbs: [4077, 4095 x 20, 7]
+P_LIMBS = np.array(
+    [(P_INT >> (BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+)
+assert sum(int(l) << (BITS * i) for i, l in enumerate(P_LIMBS)) == P_INT
+
+
+def from_int(x: int, batch: int | None = None) -> np.ndarray:
+    """Host-side: python int -> limb array (NLIMBS,) or broadcast (NLIMBS, B)."""
+    x %= P_INT
+    limbs = np.array([(x >> (BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32)
+    if batch is None:
+        return limbs
+    return np.broadcast_to(limbs[:, None], (NLIMBS, batch)).copy()
+
+
+def to_int(limbs) -> int:
+    """Host-side: limb vector (NLIMBS,) -> python int (no reduction)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (BITS * i) for i, v in enumerate(arr))
+
+
+def const(x: int):
+    """Constant field element shaped (NLIMBS, 1) for broadcasting against (NLIMBS, B)."""
+    return jnp.asarray(from_int(x)[:, None])
+
+
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def _carry_pass(x):
+    """One full carry pass over axis 0 with the 2^264 -> 9728 fold.
+
+    Input limbs may be any int32 with |x| < 2^29 (see carry() for the
+    margin analysis); output limbs are in [0, 2^12) except limb 0 which
+    absorbs the fold. Signed arithmetic shifts (floor semantics) make
+    this correct for negative limbs and value-negative inputs too.
+    """
+    out = []
+    c = jnp.zeros_like(x[0])
+    for j in range(NLIMBS):
+        t = x[j] + c
+        out.append(t & MASK)
+        c = t >> BITS
+    out[0] = out[0] + FOLD * c
+    return jnp.stack(out)
+
+
+def carry(x):
+    """Restore the loose invariant (limbs in [0, 2^13)) for |limbs| < 2^29.
+
+    Margin: pass 1 carries are < |x|max/2^12 <= 2^17, so the fold adds
+    FOLD * 2^17 < 2^31 to limb 0 without overflow (this caps the domain at
+    |x| < 2^29.7). Pass 2's carry chain collapses to <= 1 by limb 2, so its
+    fold adds at most FOLD to limb 0 (< 2^14); the final mini-carry pushes
+    limb 0's excess into limb 1, which stays < 2^13 (loose) without further
+    propagation. Value is preserved mod p throughout, including for
+    value-negative inputs (signed floor shifts).
+    """
+    x = _carry_pass(x)
+    x = _carry_pass(x)
+    l0 = x[0]
+    l1 = x[1] + (l0 >> BITS)
+    return jnp.concatenate([jnp.stack([l0 & MASK, l1]), x[2:]], axis=0)
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+# 2048*p limbwise: (a - b + SUB_BIAS) is positive limbwise (min limb
+# 2048*7 = 14336 > 8191 = max loose limb) AND value-wise (max loose value
+# < 2^265 + 2^252 < 2048*p ~= 2^266), so sub/neg never go value-negative
+# and limb magnitudes stay < 2048*4095 < 2^23, inside carry()'s domain.
+_SUB_BIAS = jnp.asarray((2048 * P_LIMBS.astype(np.int64)).astype(np.int32)[:, None])
+
+
+def sub(a, b):
+    return carry(a - b + _SUB_BIAS)
+
+
+def neg(a):
+    return carry(_SUB_BIAS - a)
+
+
+def mul(a, b):
+    """Schoolbook 22x22 limb multiply + fold + carry. a, b loose -> loose."""
+    B = a.shape[1:]
+    # t[k] = sum_{i+j=k} a[i]*b[j], k in [0, 42]; padded to 45 for carries.
+    t = jnp.zeros((2 * NLIMBS + 1,) + B, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        prod = a[i][None, :] * b  # (22, B)
+        t = t.at[i : i + NLIMBS].add(prod)
+    # Full carry over all 45 limbs (no fold yet; value < 2^540 fits 45 limbs).
+    out = []
+    c = jnp.zeros_like(t[0])
+    for j in range(2 * NLIMBS + 1):
+        v = t[j] + c
+        out.append(v & MASK)
+        c = v >> BITS
+    t = jnp.stack(out)  # every limb in [0, 2^12), carry-out is zero
+    # Fold limbs 22..43 into 0..21; limb 44 (<= 4: product < 2^530.4) folds
+    # straight into limb 0 with 2^(12*44) = (2^264)^2 ≡ FOLD^2 (mod p).
+    # lo[0] <= 4095 + FOLD*4095 + FOLD^2*4 < 2^28.7, inside carry()'s 2^29.
+    lo = t[:NLIMBS] + FOLD * t[NLIMBS : 2 * NLIMBS]
+    lo = lo.at[0].add((FOLD * FOLD) * t[2 * NLIMBS])
+    return carry(lo)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def mul_small(a, c: int):
+    """Multiply by a small constant 0 <= c < 2^13."""
+    assert 0 <= c < (1 << 13)
+    return carry(a * c)
+
+
+def _freeze_full_pass(x):
+    """Carry pass without fold; returns (limbs, carry_out)."""
+    out = []
+    c = jnp.zeros_like(x[0])
+    for j in range(NLIMBS):
+        t = x[j] + c
+        out.append(t & MASK)
+        c = t >> BITS
+    return jnp.stack(out), c
+
+
+def freeze(a):
+    """Canonical representative: limbs < 2^12, value in [0, p)."""
+    a = carry(a)
+    a, c = _freeze_full_pass(a)  # absorb limb-1 looseness; value < 2^264
+    a = a.at[0].add(FOLD * c)
+    a, c = _freeze_full_pass(a)
+    a = a.at[0].add(FOLD * c)
+    a, _ = _freeze_full_pass(a)
+    # Fold bits >= 255 out of the top limb (bits 252..263 live there).
+    top = a[NLIMBS - 1] >> 3
+    a = a.at[NLIMBS - 1].set(a[NLIMBS - 1] & 7)
+    a = a.at[0].add(19 * top)
+    a, _ = _freeze_full_pass(a)  # value now < 2^255 + eps < 2p
+    # Conditional subtract p.
+    d = a - jnp.asarray(P_LIMBS[:, None])
+    out = []
+    c = jnp.zeros_like(d[0])
+    for j in range(NLIMBS):
+        t = d[j] + c
+        out.append(t & MASK)
+        c = t >> BITS
+    d = jnp.stack(out)
+    nonneg = c == 0  # carry-out 0 => a >= p
+    return jnp.where(nonneg[None, :], d, a)
+
+
+def eq(a, b):
+    """Field equality (canonical compare). Returns bool (B,)."""
+    return jnp.all(freeze(a) == freeze(b), axis=0)
+
+
+def is_zero(a):
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def parity(a):
+    """Least significant bit of the canonical representative. (B,) int32."""
+    return freeze(a)[0] & 1
+
+
+def select(cond, a, b):
+    """cond: bool (B,); a, b: (NLIMBS, B)."""
+    return jnp.where(cond[None, :], a, b)
+
+
+def sqn(x, n: int):
+    """n repeated squarings via lax.scan (keeps the traced graph small)."""
+    if n <= 2:
+        for _ in range(n):
+            x = sq(x)
+        return x
+    return lax.scan(lambda c, _: (sq(c), None), x, None, length=n)[0]
+
+
+def pow2523(x):
+    """x^((p-5)/8) = x^(2^252 - 3), the exponent used for combined sqrt/inv.
+
+    Standard square-and-multiply addition chain (11 muls + 252 squarings),
+    re-derived from the exponent's binary structure.
+    """
+    x2 = sq(x)  # x^2
+    x9 = mul(sq(sq(x2)), x)  # x^9
+    x11 = mul(x9, x2)  # x^11
+    x31 = mul(sq(x11), x9)  # x^(2^5 - 1)
+    x_10 = mul(sqn(x31, 5), x31)  # 2^10 - 1
+    x_20 = mul(sqn(x_10, 10), x_10)  # 2^20 - 1
+    x_40 = mul(sqn(x_20, 20), x_20)  # 2^40 - 1
+    x_50 = mul(sqn(x_40, 10), x_10)  # 2^50 - 1
+    x_100 = mul(sqn(x_50, 50), x_50)  # 2^100 - 1
+    x_200 = mul(sqn(x_100, 100), x_100)  # 2^200 - 1
+    x_250 = mul(sqn(x_200, 50), x_50)  # 2^250 - 1
+    return mul(sq(sq(x_250)), x)  # x^(2^252 - 3)
+
+
+def invert(x):
+    """x^(p-2) = x^(2^255 - 21) via pow2523: p-2 = 8*(2^252-3) + 3."""
+    t = pow2523(x)
+    for _ in range(3):
+        t = sq(t)
+    # t = x^(2^255 - 24); need * x^3
+    return mul(t, mul(sq(x), x))
+
+
+def from_bytes_le(b):
+    """(B, 32) uint8 little-endian -> (22, B) loose limbs (value < 2^256).
+
+    Callers that need only 255 bits (point decoding) mask the sign bit first.
+    """
+    b = b.astype(jnp.int32)
+    padded = jnp.concatenate([b, jnp.zeros(b.shape[:-1] + (1,), jnp.int32)], axis=-1)
+    limbs = []
+    for j in range(NLIMBS):
+        bit = BITS * j
+        sb = bit // 8
+        shift = bit % 8
+        v = (padded[..., sb] >> shift) | (padded[..., sb + 1] << (8 - shift))
+        limbs.append(v & MASK)
+    return jnp.stack(limbs)  # (22, B)
+
+
+def to_bytes_le(a):
+    """(22, B) -> (B, 32) uint8 of the canonical representative."""
+    a = freeze(a)  # limbs < 2^12, value < p < 2^255
+    out = []
+    for k in range(32):
+        bit = 8 * k
+        j = bit // BITS
+        shift = bit % BITS
+        v = a[j] >> shift
+        if shift > BITS - 8 and j + 1 < NLIMBS:
+            v = v | (a[j + 1] << (BITS - shift))
+        out.append(v & 0xFF)
+    return jnp.stack(out, axis=-1).astype(jnp.uint8)

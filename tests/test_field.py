@@ -1,0 +1,144 @@
+"""Differential tests: JAX GF(2^255-19) limb arithmetic vs python ints."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cometbft_tpu.ops import field as F
+
+P = F.P_INT
+rng = np.random.default_rng(0)
+
+# jit wrappers: these ops build thousands-of-ops graphs; eager dispatch is slow
+f_add = jax.jit(lambda a, b: F.freeze(F.add(a, b)))
+f_sub = jax.jit(lambda a, b: F.freeze(F.sub(a, b)))
+f_neg = jax.jit(lambda a: F.freeze(F.neg(a)))
+f_mul = jax.jit(lambda a, b: F.freeze(F.mul(a, b)))
+f_sq = jax.jit(lambda a: F.freeze(F.sq(a)))
+f_inv = jax.jit(lambda a: F.freeze(F.invert(a)))
+f_pow2523 = jax.jit(lambda a: F.freeze(F.pow2523(a)))
+f_freeze = jax.jit(F.freeze)
+
+
+def _rand_ints(n, lo=0, hi=P):
+    return [int.from_bytes(rng.bytes(33), "little") % (hi - lo) + lo for _ in range(n)]
+
+
+def _pack(vals):
+    """list of python ints -> (22, B) limb array."""
+    return jnp.stack([jnp.asarray(F.from_int(v)) for v in vals], axis=1)
+
+
+def _unpack(arr):
+    arr = np.asarray(arr)
+    return [F.to_int(arr[:, i]) for i in range(arr.shape[1])]
+
+
+ADVERSARIAL = [
+    0,
+    1,
+    2,
+    19,
+    P - 1,
+    P - 2,
+    P,  # from_int reduces; loose forms tested separately
+    2**255 - 1 - P,  # small
+    (1 << 255) - 20,
+    F.to_int(np.full(22, 4095, np.int32)) % P,  # all-ones limbs
+]
+
+
+def test_roundtrip():
+    vals = ADVERSARIAL + _rand_ints(32)
+    assert _unpack(_pack(vals)) == [v % P for v in vals]
+
+
+def test_add_sub_neg():
+    a = ADVERSARIAL + _rand_ints(32)
+    b = list(reversed(ADVERSARIAL)) + _rand_ints(32)
+    A, B = _pack(a), _pack(b)
+    got = _unpack(f_add(A, B))
+    assert got == [(x + y) % P for x, y in zip(a, b)]
+    got = _unpack(f_sub(A, B))
+    assert got == [(x - y) % P for x, y in zip(a, b)]
+    got = _unpack(f_neg(A))
+    assert got == [(-x) % P for x in a]
+
+
+def test_mul_sq():
+    a = ADVERSARIAL + _rand_ints(48)
+    b = list(reversed(ADVERSARIAL)) + _rand_ints(48)
+    A, B = _pack(a), _pack(b)
+    got = _unpack(f_mul(A, B))
+    assert got == [(x * y) % P for x, y in zip(a, b)]
+    got = _unpack(f_sq(A))
+    assert got == [(x * x) % P for x in a]
+
+
+def test_mul_loose_inputs():
+    """Multiplication must be safe on maximally-loose (2^13-1) limbs."""
+    loose = jnp.full((22, 4), 8191, jnp.int32)
+    val = F.to_int(np.full(22, 8191, np.int64))
+    got = _unpack(f_mul(loose, loose))
+    assert got == [(val * val) % P] * 4
+    # chains of ops on loose values
+    x = F.mul(F.add(loose, loose), F.sub(loose, F.mul(loose, loose)))
+    v = ((val + val) * (val - val * val)) % P
+    assert _unpack(f_freeze(x)) == [v] * 4
+
+
+def test_freeze_canonical():
+    # freeze of p, 2p-1-ish, and values >= p must land in [0, p)
+    vals = [0, 1, P - 1]
+    arr = _pack(vals)
+    frozen = np.asarray(f_freeze(arr))
+    assert (frozen[:, 0] == 0).all()
+    assert F.to_int(frozen[:, 2]) == P - 1
+    # non-canonical loose encodings of small values
+    biased = arr + np.asarray(1024 * F.P_LIMBS[:, None])  # +1024p, loose-ish
+    assert _unpack(f_freeze(F.carry(biased))) == vals
+
+
+def test_invert_pow2523():
+    a = [v for v in ADVERSARIAL if v % P != 0] + _rand_ints(16)
+    A = _pack(a)
+    got = _unpack(f_inv(A))
+    assert got == [pow(x % P, P - 2, P) for x in a]
+    got = _unpack(f_pow2523(A))
+    assert got == [pow(x % P, (P - 5) // 8, P) for x in a]
+
+
+def test_eq_iszero_parity():
+    a = [5, 0, P - 1, 7]
+    b = [5, 1, P - 1, 8]
+    A, B = _pack(a), _pack(b)
+    assert list(np.asarray(F.eq(A, B))) == [True, False, True, False]
+    assert list(np.asarray(F.is_zero(_pack([0, 3, P, 1])))) == [True, False, True, False]
+    assert list(np.asarray(F.parity(_pack([4, 7, P - 1, P - 2])))) == [
+        0, 1, (P - 1) & 1, (P - 2) & 1]
+
+
+def test_bytes_roundtrip():
+    vals = _rand_ints(16) + [0, 1, P - 1]
+    byts = np.stack([np.frombuffer(v.to_bytes(32, "little"), np.uint8) for v in vals])
+    limbs = F.from_bytes_le(jnp.asarray(byts))
+    assert _unpack(f_freeze(limbs)) == [v % P for v in vals]
+    back = np.asarray(F.to_bytes_le(limbs))
+    for i, v in enumerate(vals):
+        assert int.from_bytes(back[i].tobytes(), "little") == v % P
+
+
+def test_from_bytes_full_256_bits():
+    """from_bytes_le must carry all 256 bits (incl. the sign bit) when unmasked."""
+    v = (1 << 256) - 1
+    byts = np.frombuffer(v.to_bytes(32, "little"), np.uint8)[None, :]
+    limbs = F.from_bytes_le(jnp.asarray(byts))
+    assert F.to_int(np.asarray(limbs)[:, 0]) == v
+
+
+def test_mul_small():
+    a = _rand_ints(8) + [P - 1]
+    A = _pack(a)
+    got = _unpack(f_freeze(F.mul_small(A, 121)))
+    assert got == [(x * 121) % P for x in a]
