@@ -1,0 +1,61 @@
+"""North-star benchmark: Ed25519 batch-verify throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors BASELINE.json config #5's scale (10k-validator mega-commit):
+a 10_000-signature batch (padded to the 16384 bucket) of distinct
+(pubkey, msg, sig) triples with ~120-byte canonical-vote-sized messages.
+
+Baseline: the reference's CPU batch verifier (curve25519-voi with amd64
+assembly, reference crypto/ed25519/bench_test.go:30) measures ~1-2 us/sig
+at batch>=1024 on modern x86; we use 1.0 us/sig (1.0e6 sigs/s, the fast
+end) as the baseline constant since the Go toolchain is not available in
+this image to run the harness directly.
+"""
+
+import json
+import time
+
+import numpy as np
+
+CPU_BASELINE_SIGS_PER_SEC = 1.0e6
+N_SIGS = 10_000
+
+
+def main():
+    from cometbft_tpu.crypto.ed25519 import Ed25519BatchVerifier, Ed25519PubKey
+    from cometbft_tpu.crypto.testgen import generate_signed_batch
+
+    # Distinct keys + messages for every lane, generated with the device
+    # fixed-base ladder (host signing would dominate setup time).
+    items = generate_signed_batch(N_SIGS, seed=0, msg_len=100)
+
+    def run_once():
+        bv = Ed25519BatchVerifier(backend="tpu")
+        for pub, msg, sig in items:
+            bv.add(Ed25519PubKey(pub), msg, sig)
+        ok, bits = bv.verify()
+        assert ok, "bench batch must verify"
+        return bits
+
+    run_once()  # warmup: compile the bucket
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        run_once()
+    dt = (time.perf_counter() - t0) / iters
+    sigs_per_sec = N_SIGS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput_10k",
+                "value": round(sigs_per_sec, 1),
+                "unit": "sigs/sec/chip",
+                "vs_baseline": round(sigs_per_sec / CPU_BASELINE_SIGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

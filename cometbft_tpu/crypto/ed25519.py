@@ -1,0 +1,170 @@
+"""Ed25519 key types and the TPU-backed batch verifier.
+
+The signing path is host-side (consensus signs one vote at a time); the
+verification path has two backends behind the BatchVerifier seam:
+
+- `Ed25519BatchVerifier(backend="tpu")` — packs fixed-shape arrays, hashes
+  SHA-512(R||A||M) host-side (cheap, ~us), and runs the batched ZIP-215
+  kernel from cometbft_tpu.ops.ed25519_verify on device. Batches are padded
+  to power-of-two buckets so each bucket compiles exactly once.
+- `backend="cpu"` — pure-Python oracle (spec-exact, used for differential
+  tests and as fallback).
+
+Behavior parity: reference crypto/ed25519/ed25519.go (sign :91, verify
+:180-187 with ZIP-215 options :36-41, batch :207-240). The reference's
+LRU cache of expanded pubkeys (:43,68) has no analogue here: decompression
+happens on-device inside the batch, where it is amortized across lanes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from . import ed25519_ref as ref
+from .keys import BatchVerifier, PrivKey, PubKey, tmhash20
+
+KEY_TYPE = "tendermint/PubKeyEd25519"
+PUB_KEY_SIZE = 32
+PRIV_KEY_SIZE = 64  # seed || pubkey, matching common ed25519 private encoding
+SIG_SIZE = 64
+
+# Padded batch buckets: one compiled kernel per size.
+BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+
+
+class Ed25519PubKey(PubKey):
+    __slots__ = ("_b",)
+
+    def __init__(self, b: bytes):
+        if len(b) != PUB_KEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._b = bytes(b)
+
+    def address(self) -> bytes:
+        return tmhash20(self._b)
+
+    def bytes(self) -> bytes:
+        return self._b
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return ref.verify(self._b, msg, sig)
+
+    def type_tag(self) -> str:
+        return KEY_TYPE
+
+    def __repr__(self):
+        return f"Ed25519PubKey({self._b.hex()[:16]}…)"
+
+
+class Ed25519PrivKey(PrivKey):
+    __slots__ = ("_seed", "_pub")
+
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) == 32:
+            self._seed = bytes(key_bytes)
+            self._pub = ref.pubkey_from_seed(self._seed)
+        elif len(key_bytes) == PRIV_KEY_SIZE:
+            self._seed = bytes(key_bytes[:32])
+            self._pub = bytes(key_bytes[32:])
+        else:
+            raise ValueError("ed25519 privkey must be 32 (seed) or 64 bytes")
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivKey":
+        return cls(ref.generate_seed())
+
+    def sign(self, msg: bytes) -> bytes:
+        return ref.sign(self._seed, msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self._pub)
+
+    def bytes(self) -> bytes:
+        return self._seed + self._pub
+
+    def type_tag(self) -> str:
+        return KEY_TYPE
+
+
+def _nibble_windows(b32: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 -> (B, 64) int32 little-endian 4-bit windows."""
+    lo = (b32 & 15).astype(np.int32)
+    hi = (b32 >> 4).astype(np.int32)
+    return np.stack([lo, hi], axis=-1).reshape(b32.shape[0], 64)
+
+
+def _bucket(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return ((n + BUCKETS[-1] - 1) // BUCKETS[-1]) * BUCKETS[-1]
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """Batch verifier; `backend` selects tpu (default) or cpu oracle."""
+
+    def __init__(self, backend: str = "tpu"):
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+        self._precheck_fail: list[bool] = []
+        self.backend = backend
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+        if not isinstance(pub_key, Ed25519PubKey):
+            return False
+        ok = len(sig) == SIG_SIZE
+        if ok:
+            s = int.from_bytes(sig[32:], "little")
+            ok = s < ref.L  # non-canonical S rejected up front (ZIP-215 rule)
+        self._items.append((pub_key.bytes(), msg, sig if ok else b"\x00" * 64))
+        self._precheck_fail.append(not ok)
+        return ok
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if not self._items:
+            return False, []
+        if self.backend == "cpu":
+            bits = [
+                (not bad) and ref.verify(p, m, s)
+                for (p, m, s), bad in zip(self._items, self._precheck_fail)
+            ]
+            return all(bits), bits
+        bits = list(self._verify_device())
+        bits = [bool(b) and not bad for b, bad in zip(bits, self._precheck_fail)]
+        return all(bits), bits
+
+    def _verify_device(self) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ..ops.ed25519_verify import verify_batch_jit
+
+        n = len(self._items)
+        b = _bucket(n)
+        a_bytes = np.zeros((b, 32), np.uint8)
+        r_bytes = np.zeros((b, 32), np.uint8)
+        s_raw = np.zeros((b, 32), np.uint8)
+        k_raw = np.zeros((b, 32), np.uint8)
+        live = np.zeros((b,), bool)
+        for i, (pub, msg, sig) in enumerate(self._items):
+            a_bytes[i] = np.frombuffer(pub, np.uint8)
+            r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
+            s_raw[i] = np.frombuffer(sig[32:], np.uint8)
+            k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % ref.L
+            k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+            live[i] = True
+        out = verify_batch_jit(
+            jnp.asarray(a_bytes),
+            jnp.asarray(r_bytes),
+            jnp.asarray(_nibble_windows(s_raw)),
+            jnp.asarray(_nibble_windows(k_raw)),
+            jnp.asarray(live),
+        )
+        return np.asarray(out)[:n]
+
+
+def batch_verifier(backend: str = "tpu") -> Ed25519BatchVerifier:
+    return Ed25519BatchVerifier(backend=backend)

@@ -1,0 +1,208 @@
+"""Batched edwards25519 point operations in JAX.
+
+Points are tuples (X, Y, Z, T) of (22, B) int32 limb arrays — extended
+homogeneous coordinates on the twisted Edwards curve -x^2 + y^2 = 1 + d x^2 y^2
+with x = X/Z, y = Y/Z, T = XY/Z.
+
+The addition law used (add-2008-hwcd-3) is *complete* for a = -1 (a square
+mod p) and d non-square, so it is valid for every curve point including the
+8-torsion components that ZIP-215 liberal decoding admits — no branch needed
+for doubling or identity inputs inside the table build.
+
+Behavior parity target: the curve math backing the reference's batch
+verifier (reference: crypto/ed25519/ed25519.go:207-240 via curve25519-voi);
+the *design* (limb layout, complete-formula ladder, windowed Shamir scan)
+is TPU-native and original.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto import ed25519_ref as ref
+from . import field as F
+
+P = F.P_INT
+_D2_INT = (2 * ref.D) % P
+
+# Broadcastable (22, 1) constants.
+D_C = F.const(ref.D)
+D2_C = F.const(_D2_INT)
+SQRT_M1_C = F.const(ref.SQRT_M1)
+ONE_C = F.const(1)
+
+
+def identity(batch: int):
+    z = jnp.zeros((F.NLIMBS, batch), jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], (F.NLIMBS, batch))
+    return (z, one, one, z)
+
+
+def add(p, q):
+    """Complete unified addition (add-2008-hwcd-3, a=-1)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    b = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    c = F.mul(F.mul(T1, D2_C), T2)
+    d = F.mul(F.add(Z1, Z1), Z2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def dbl(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1); valid for all points."""
+    X1, Y1, Z1, _ = p
+    a = F.sq(X1)
+    b = F.sq(Y1)
+    zz = F.sq(Z1)
+    c = F.add(zz, zz)
+    e = F.sub(F.sub(F.sq(F.add(X1, Y1)), a), b)
+    g = F.sub(b, a)  # aA + B with a = -1
+    f = F.sub(g, c)  # hwcd: F = G - C ... sign fixed by tests vs oracle
+    h = F.neg(F.add(a, b))  # aA - B
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def neg(p):
+    X, Y, Z, T = p
+    return (F.neg(X), Y, Z, F.neg(T))
+
+
+def is_identity(p):
+    X, Y, Z, _ = p
+    return F.is_zero(X) & F.eq(Y, Z)
+
+
+def eq_points(p, q):
+    """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1."""
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return F.eq(F.mul(X1, Z2), F.mul(X2, Z1)) & F.eq(F.mul(Y1, Z2), F.mul(Y2, Z1))
+
+
+def decompress(b):
+    """ZIP-215 liberal point decoding.
+
+    b: (B, 32) uint8 encodings. Returns (valid: bool (B,), point).
+    Non-canonical y (>= p) is reduced mod p; x == 0 with sign bit 1 is
+    accepted as x = 0. Invalid (non-square x^2 candidate) lanes return
+    valid=False with an arbitrary well-formed point.
+    """
+    b = jnp.asarray(b)
+    sign = (b[:, 31].astype(jnp.int32) >> 7) & 1  # (B,)
+    masked = b.at[:, 31].set(b[:, 31] & 0x7F)
+    y = F.from_bytes_le(masked)  # < 2^255, loose
+    yy = F.sq(y)
+    u = F.sub(yy, ONE_C)
+    v = F.add(F.mul(yy, D_C), ONE_C)
+    v3 = F.mul(F.sq(v), v)
+    v7 = F.mul(F.sq(v3), v)
+    x = F.mul(F.mul(u, v3), F.pow2523(F.mul(u, v7)))
+    vxx = F.mul(v, F.sq(x))
+    ok_direct = F.eq(vxx, u)
+    ok_flip = F.eq(vxx, F.neg(u))
+    x = F.select(ok_flip, F.mul(x, SQRT_M1_C), x)
+    valid = ok_direct | ok_flip
+    flip_sign = F.parity(x) != sign
+    x = F.select(flip_sign, F.neg(x), x)
+    return valid, (x, y, jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], y.shape), F.mul(x, y))
+
+
+def compress(p):
+    """(B, 32) uint8 canonical encodings (inverts Z; host/test use only)."""
+    X, Y, Z, _ = p
+    zi = F.invert(Z)
+    x = F.freeze(F.mul(X, zi))
+    y = F.mul(Y, zi)
+    enc = F.to_bytes_le(y)
+    return enc.at[:, 31].set(enc[:, 31] | ((x[0] & 1) << 7).astype(jnp.uint8))
+
+
+# --- Fixed-base window table: TB[i] = i * B, i in 0..15, extended affine ---
+def _host_table() -> np.ndarray:
+    out = np.zeros((16, 4, F.NLIMBS), np.int32)
+    for i in range(16):
+        pt = ref._ext_scalar_mul(i, ref.B_POINT)
+        if i == 0:
+            x, y = 0, 1
+        else:
+            x, y = ref._ext_to_affine(pt)
+        out[i, 0] = F.from_int(x)
+        out[i, 1] = F.from_int(y)
+        out[i, 2] = F.from_int(1)
+        out[i, 3] = F.from_int((x * y) % P)
+    return out
+
+
+BASE_TABLE = jnp.asarray(_host_table())  # (16, 4, 22)
+
+
+def _select_const(table, wins):
+    """Select rows of a constant (16, 4, 22) table per lane. wins: (B,) int32."""
+    mask = (wins[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(jnp.int32)
+    # (16,B) x (16,4,22) -> (4,22,B)
+    return jnp.einsum("tb,tcl->clb", mask, table)
+
+
+def _select_lane(table, wins):
+    """Select from a per-lane (16, 4, 22, B) table. wins: (B,) int32."""
+    mask = (wins[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(jnp.int32)
+    return (mask[:, None, None, :] * table).sum(0)
+
+
+def _lane_table(a_point):
+    """Per-lane window table [0, A, 2A, ..., 15A] as one (16, 4, 22, B) array."""
+    batch = a_point[0].shape[1]
+    pts = [identity(batch), a_point]
+    for _ in range(14):
+        pts.append(add(pts[-1], a_point))
+    return jnp.stack([jnp.stack(p) for p in pts])  # (16, 4, 22, B)
+
+
+def shamir(s_wins, k_wins, a_point):
+    """[s]B + [k]A with shared doublings (Straus/Shamir), 4-bit windows.
+
+    s_wins, k_wins: (B, 64) int32 nibble windows, little-endian (window w
+    covers bits [4w, 4w+4)). a_point: batched extended point. The ladder
+    scans windows from most to least significant under lax.scan; every
+    iteration does 4 doublings + 2 complete additions, identical across
+    lanes (no data-dependent control flow).
+    """
+    batch = s_wins.shape[0]
+    ta = _lane_table(a_point)  # (16,4,22,B)
+    xs = (
+        jnp.flip(s_wins.T, axis=0),  # (64, B), most-significant first
+        jnp.flip(k_wins.T, axis=0),
+    )
+
+    def body(r, w):
+        ws, wk = w
+        r = dbl(dbl(dbl(dbl(r))))
+        sb = _select_const(BASE_TABLE, ws)
+        r = add(r, (sb[0], sb[1], sb[2], sb[3]))
+        sa = _select_lane(ta, wk)
+        r = add(r, (sa[0], sa[1], sa[2], sa[3]))
+        return r, None
+
+    r0 = identity(batch)
+    r, _ = lax.scan(body, r0, xs)
+    return r
+
+
+def mul8(p):
+    return dbl(dbl(dbl(p)))
+
+
+def scalar_windows(scalars) -> np.ndarray:
+    """Host-side: iterable of python ints -> (B, 64) int32 nibble windows."""
+    out = np.zeros((len(scalars), 64), np.int32)
+    for i, s in enumerate(scalars):
+        for w in range(64):
+            out[i, w] = (s >> (4 * w)) & 15
+    return out

@@ -1,0 +1,46 @@
+"""Batched Ed25519 ZIP-215 verification — the TPU data-plane kernel.
+
+Per-lane cofactored verification: each lane checks
+    [8]([S]B + [k](-A) - R) == identity
+with liberal (ZIP-215) decoding of A and R. This is the device half of the
+reference's batch verifier (reference: crypto/ed25519/ed25519.go:207-240,
+types/validation.go:214 verifyCommitBatch); unlike the CPU random-linear-
+combination trick, per-lane verification is embarrassingly parallel on TPU
+lanes AND yields the per-signature validity bitmap that the commit-verify
+fallback scan needs (reference: types/validation.go:304-311) for free.
+
+Host-side responsibilities (see crypto/ed25519.py): SHA-512 of
+(R || A || M) reduced mod L -> k windows, S < L rejection, padding.
+Device inputs are fixed-shape uint8/int32 arrays; no data-dependent
+control flow — one trace per batch bucket, compiled once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import curve as C
+from . import field as F
+
+
+def verify_batch(a_bytes, r_bytes, s_wins, k_wins, live):
+    """Batched ZIP-215 verify.
+
+    a_bytes, r_bytes: (B, 32) uint8 — as-received A and R encodings.
+    s_wins, k_wins:   (B, 64) int32 — 4-bit little-endian windows of S and
+                      k = SHA-512(R||A||M) mod L (host-computed).
+    live:             (B,) bool — padding mask (False lanes report False).
+
+    Returns (B,) bool validity bitmap.
+    """
+    ok_a, a_pt = C.decompress(a_bytes)
+    ok_r, r_pt = C.decompress(r_bytes)
+    # [S]B + [k](-A)
+    acc = C.shamir(s_wins, k_wins, C.neg(a_pt))
+    acc = C.add(acc, C.neg(r_pt))
+    ok_eq = C.is_identity(C.mul8(acc))
+    return ok_a & ok_r & ok_eq & live
+
+
+verify_batch_jit = jax.jit(verify_batch)

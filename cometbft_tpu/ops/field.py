@@ -1,22 +1,28 @@
 """GF(2^255 - 19) arithmetic in JAX, vectorized over a trailing batch axis.
 
 Representation: little-endian base-2^12 limbs in int32, shape (22, B).
-p = 2^255 - 19; 22 * 12 = 264 bits, so 2^264 = 2^9 * 2^255 = 512 * (p + 19)
-=> 2^264 ≡ 512 * 19 = 9728 (mod p), the carry-fold constant.
+p = 2^255 - 19; 22 * 12 = 264 bits, so 2^264 ≡ 512 * 19 = 9728 (mod p),
+the carry-fold constant FOLD.
 
-Invariant "loose": every limb in [0, 2^13). Products of two loose elements
-sum at most 22 * (2^13 - 1)^2 < 2^31, so schoolbook multiplication never
-overflows int32. `carry()` restores looseness; `freeze()` produces the
-canonical representative (limbs < 2^12, value < p) for comparisons.
+Loose invariant (what every op returns and accepts):
+    limb 0   in [0, 13824)   (absorbs carry folds; < 2^13.76)
+    limbs 1+ in [0, 4200)    (~canonical 2^12 plus ripple slack)
+Schoolbook products then sum to at most
+    2 * 13823 * 4199 + 20 * 4199^2 < 2^29  « int32,
+so multiplication never overflows.
 
-Why 12-bit limbs (not 16 or 25.5): the TPU VPU has int32 multiply but no
-native 64-bit accumulate, so limb products plus their 22-term accumulation
-must stay inside int32. 12-bit limbs leave 5 bits of headroom, which keeps
-the loose/carry bound analysis simple and branch-free.
+Carries are *parallel rounds*, not sequential chains: one round masks every
+limb and shifts all carries up one position simultaneously (top carry folds
+into limb 0 via FOLD). 2-3 rounds restore the loose invariant for every op's
+intermediate bounds (documented per-op below). This keeps traced graphs ~10x
+smaller than a sequential 22-step carry chain and maps to pure VPU ops.
 
-Design (not a port): the reference delegates all of this to
+Why 12-bit limbs: the TPU VPU has int32 multiply but no 64-bit accumulate,
+so limb products plus their 22-term accumulation must fit in int32.
+
+Design (not a port): the reference delegates field arithmetic to
 curve25519-voi's amd64 assembly (reference: go.mod:55,
-crypto/ed25519/ed25519.go:13); we re-derive it for int32 SIMD lanes.
+crypto/ed25519/ed25519.go:13); this is a re-derivation for int32 SIMD lanes.
 """
 
 from __future__ import annotations
@@ -31,7 +37,6 @@ MASK = (1 << BITS) - 1
 FOLD = 9728  # 2^264 mod p
 P_INT = 2**255 - 19
 
-# p in base-2^12 limbs: [4077, 4095 x 20, 7]
 P_LIMBS = np.array(
     [(P_INT >> (BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
 )
@@ -54,58 +59,54 @@ def to_int(limbs) -> int:
 
 
 def const(x: int):
-    """Constant field element shaped (NLIMBS, 1) for broadcasting against (NLIMBS, B)."""
+    """Constant field element shaped (NLIMBS, 1) for broadcasting."""
     return jnp.asarray(from_int(x)[:, None])
 
 
-def zeros_like(x):
-    return jnp.zeros_like(x)
+def _round(x, fold: bool):
+    """One parallel carry round: mask all limbs, shift carries up one slot.
 
-
-def _carry_pass(x):
-    """One full carry pass over axis 0 with the 2^264 -> 9728 fold.
-
-    Input limbs may be any int32 with |x| < 2^29 (see carry() for the
-    margin analysis); output limbs are in [0, 2^12) except limb 0 which
-    absorbs the fold. Signed arithmetic shifts (floor semantics) make
-    this correct for negative limbs and value-negative inputs too.
+    Signed arithmetic shifts give floor semantics, so this is correct for
+    negative limbs (value is preserved mod p). With fold=True the top
+    carry re-enters limb 0 scaled by FOLD; with fold=False the top carry
+    must be provably zero (only used on the wide product array).
     """
-    out = []
-    c = jnp.zeros_like(x[0])
-    for j in range(NLIMBS):
-        t = x[j] + c
-        out.append(t & MASK)
-        c = t >> BITS
-    out[0] = out[0] + FOLD * c
-    return jnp.stack(out)
+    m = x & MASK
+    hi = x >> BITS
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    if fold:
+        top = jnp.concatenate(
+            [FOLD * hi[-1:], jnp.zeros_like(hi[1:])], axis=0
+        )
+        return m + up + top
+    return m + up
 
 
 def carry(x):
-    """Restore the loose invariant (limbs in [0, 2^13)) for |limbs| < 2^29.
+    """Restore the loose invariant for |limbs| < 2^29 (3 folded rounds).
 
-    Margin: pass 1 carries are < |x|max/2^12 <= 2^17, so the fold adds
-    FOLD * 2^17 < 2^31 to limb 0 without overflow (this caps the domain at
-    |x| < 2^29.7). Pass 2's carry chain collapses to <= 1 by limb 2, so its
-    fold adds at most FOLD to limb 0 (< 2^14); the final mini-carry pushes
-    limb 0's excess into limb 1, which stays < 2^13 (loose) without further
-    propagation. Value is preserved mod p throughout, including for
-    value-negative inputs (signed floor shifts).
+    Overflow margin: round 1's fold adds FOLD * (|x|max >> 12) < 2^30 to
+    limb 0 — int32-safe up to |x| < 2^29.3. Convergence: round 1 leaves
+    carries <= 2^17; round 2 collapses all but limbs 0-2 to < 4100 and
+    limb 0/1 to < 2^15.1; round 3 lands the loose invariant (limb 0 <=
+    4095 + FOLD = 13823, limbs 1.. < 4200). Worst-case chains were checked
+    for the actual producers: add (2^14.8), sub (2^23.1), mul (2^28.7),
+    mul_small (2^26.8).
     """
-    x = _carry_pass(x)
-    x = _carry_pass(x)
-    l0 = x[0]
-    l1 = x[1] + (l0 >> BITS)
-    return jnp.concatenate([jnp.stack([l0 & MASK, l1]), x[2:]], axis=0)
+    x = _round(x, True)
+    x = _round(x, True)
+    return _round(x, True)
 
 
 def add(a, b):
-    return carry(a + b)
+    """Loose + loose: limbs <= 27646; 2 rounds suffice (carries <= 6)."""
+    return _round(_round(a + b, True), True)
 
 
 # 2048*p limbwise: (a - b + SUB_BIAS) is positive limbwise (min limb
-# 2048*7 = 14336 > 8191 = max loose limb) AND value-wise (max loose value
-# < 2^265 + 2^252 < 2048*p ~= 2^266), so sub/neg never go value-negative
-# and limb magnitudes stay < 2048*4095 < 2^23, inside carry()'s domain.
+# 2048*7 = 14336 > 13823 = max loose limb) AND value-wise (max loose value
+# < 2^265.01 < 2048*p ~= 2^266), so sub/neg never go value-negative and
+# limb magnitudes stay < 2048*4095 + 13824 < 2^23.1, inside carry()'s domain.
 _SUB_BIAS = jnp.asarray((2048 * P_LIMBS.astype(np.int64)).astype(np.int32)[:, None])
 
 
@@ -117,27 +118,36 @@ def neg(a):
     return carry(_SUB_BIAS - a)
 
 
+# Anti-diagonal gather matrix: (i, j) -> position i + j, flattened to
+# (484, 45). The limb product becomes ONE outer product + ONE int32 matmul,
+# keeping traced graphs ~5x smaller than an unrolled shift-accumulate (big
+# compile-time win) and giving XLA a single large contraction to tile.
+_CONV = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS + 1), np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV[_i * NLIMBS + _j, _i + _j] = 1
+_CONV_J = jnp.asarray(_CONV)
+
+
 def mul(a, b):
-    """Schoolbook 22x22 limb multiply + fold + carry. a, b loose -> loose."""
-    B = a.shape[1:]
-    # t[k] = sum_{i+j=k} a[i]*b[j], k in [0, 42]; padded to 45 for carries.
-    t = jnp.zeros((2 * NLIMBS + 1,) + B, dtype=jnp.int32)
-    for i in range(NLIMBS):
-        prod = a[i][None, :] * b  # (22, B)
-        t = t.at[i : i + NLIMBS].add(prod)
-    # Full carry over all 45 limbs (no fold yet; value < 2^540 fits 45 limbs).
-    out = []
-    c = jnp.zeros_like(t[0])
-    for j in range(2 * NLIMBS + 1):
-        v = t[j] + c
-        out.append(v & MASK)
-        c = v >> BITS
-    t = jnp.stack(out)  # every limb in [0, 2^12), carry-out is zero
-    # Fold limbs 22..43 into 0..21; limb 44 (<= 4: product < 2^530.4) folds
-    # straight into limb 0 with 2^(12*44) = (2^264)^2 ≡ FOLD^2 (mod p).
-    # lo[0] <= 4095 + FOLD*4095 + FOLD^2*4 < 2^28.7, inside carry()'s 2^29.
-    lo = t[:NLIMBS] + FOLD * t[NLIMBS : 2 * NLIMBS]
-    lo = lo.at[0].add((FOLD * FOLD) * t[2 * NLIMBS])
+    """Schoolbook 22x22 limb multiply. Loose inputs -> loose output.
+
+    Product limbs t[k] = sum_{i+j=k} a[i]b[j] < 2^29 (loose bound above),
+    computed as outer-product + anti-diagonal contraction. Two unfolded
+    rounds over the 45-limb array bring every limb under 2^12 + 2^5 (top
+    carry is zero: value < 2^530 < 2^540). The upper limbs then fold into
+    the lower 22 (limb 44, <= 4, folds straight to limb 0 with FOLD^2),
+    leaving limbs < 2^28.7, and three folded rounds restore looseness.
+    """
+    prod = (a[:, None, :] * b[None, :, :]).reshape(NLIMBS * NLIMBS, -1)
+    t = jnp.einsum("pk,pb->kb", _CONV_J, prod)  # (45, B)
+    t = _round(t, False)
+    t = _round(t, False)
+    lo = (
+        t[:NLIMBS]
+        + FOLD * t[NLIMBS : 2 * NLIMBS]
+        + jnp.pad((FOLD * FOLD) * t[2 * NLIMBS][None, :], ((0, NLIMBS - 1), (0, 0)))
+    )
     return carry(lo)
 
 
@@ -146,13 +156,16 @@ def sq(a):
 
 
 def mul_small(a, c: int):
-    """Multiply by a small constant 0 <= c < 2^13."""
+    """Multiply by a small constant 0 <= c < 2^13. |a*c| < 2^26.8 -> carry-able.
+
+    Round 1 fold stays in int32: FOLD * (2^26.8 >> 12) < 2^28.1.
+    """
     assert 0 <= c < (1 << 13)
     return carry(a * c)
 
 
-def _freeze_full_pass(x):
-    """Carry pass without fold; returns (limbs, carry_out)."""
+def _seq_pass(x):
+    """Sequential carry pass without fold; returns (limbs, carry_out)."""
     out = []
     c = jnp.zeros_like(x[0])
     for j in range(NLIMBS):
@@ -163,29 +176,27 @@ def _freeze_full_pass(x):
 
 
 def freeze(a):
-    """Canonical representative: limbs < 2^12, value in [0, p)."""
+    """Canonical representative: limbs < 2^12, value in [0, p).
+
+    Rare op (a handful per signature vs thousands of muls), so the exact
+    sequential passes here are fine.
+    """
     a = carry(a)
-    a, c = _freeze_full_pass(a)  # absorb limb-1 looseness; value < 2^264
+    a, c = _seq_pass(a)
     a = a.at[0].add(FOLD * c)
-    a, c = _freeze_full_pass(a)
+    a, c = _seq_pass(a)
     a = a.at[0].add(FOLD * c)
-    a, _ = _freeze_full_pass(a)
+    a, _ = _seq_pass(a)
     # Fold bits >= 255 out of the top limb (bits 252..263 live there).
     top = a[NLIMBS - 1] >> 3
     a = a.at[NLIMBS - 1].set(a[NLIMBS - 1] & 7)
     a = a.at[0].add(19 * top)
-    a, _ = _freeze_full_pass(a)  # value now < 2^255 + eps < 2p
+    a, _ = _seq_pass(a)  # value now < 2^255 + eps < 2p
     # Conditional subtract p.
     d = a - jnp.asarray(P_LIMBS[:, None])
-    out = []
-    c = jnp.zeros_like(d[0])
-    for j in range(NLIMBS):
-        t = d[j] + c
-        out.append(t & MASK)
-        c = t >> BITS
-    d = jnp.stack(out)
-    nonneg = c == 0  # carry-out 0 => a >= p
-    return jnp.where(nonneg[None, :], d, a)
+    d, c = _seq_pass(d)
+    nonneg = c == 0  # borrow-free => a >= p
+    return jnp.where(nonneg[None], d, a)
 
 
 def eq(a, b):
@@ -217,7 +228,7 @@ def sqn(x, n: int):
 
 
 def pow2523(x):
-    """x^((p-5)/8) = x^(2^252 - 3), the exponent used for combined sqrt/inv.
+    """x^((p-5)/8) = x^(2^252 - 3), the exponent for combined sqrt/inverse.
 
     Standard square-and-multiply addition chain (11 muls + 252 squarings),
     re-derived from the exponent's binary structure.
@@ -237,11 +248,10 @@ def pow2523(x):
 
 
 def invert(x):
-    """x^(p-2) = x^(2^255 - 21) via pow2523: p-2 = 8*(2^252-3) + 3."""
+    """x^(p-2): p-2 = 8*(2^252 - 3) + 3."""
     t = pow2523(x)
     for _ in range(3):
         t = sq(t)
-    # t = x^(2^255 - 24); need * x^3
     return mul(t, mul(sq(x), x))
 
 

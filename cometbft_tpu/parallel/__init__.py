@@ -1,0 +1,1 @@
+"""Multi-chip scale-out: shard the signature batch axis over a device mesh."""

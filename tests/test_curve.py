@@ -1,0 +1,165 @@
+"""Differential tests: JAX edwards25519 point ops vs the Python oracle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import curve as C
+from cometbft_tpu.ops import field as F
+
+P = F.P_INT
+rng = np.random.default_rng(7)
+
+
+def _torsion_point():
+    """A nontrivial 8-torsion point: [L]P for P outside the prime subgroup."""
+    for y in range(2, 50):
+        aff = ref._decode_point(y.to_bytes(32, "little"), zip215=True)
+        if aff is None:
+            continue
+        t = ref._ext_scalar_mul(ref.L, ref._to_ext(aff))
+        if not ref._ext_is_identity(t):
+            return t
+    raise AssertionError("no torsion point found")
+
+
+TORSION = _torsion_point()
+
+
+def _rand_points(n):
+    """Random curve points; every third has an 8-torsion component mixed in
+    (the ZIP-215-admitted points outside the prime-order subgroup that the
+    complete addition law must handle)."""
+    pts = []
+    for i in range(n):
+        k = int.from_bytes(rng.bytes(32), "little") % ref.L
+        p = ref._ext_scalar_mul(k if k else 1, ref.B_POINT)
+        if i % 3 == 2:
+            p = ref._ext_add(p, TORSION)
+        pts.append(p)
+    return pts
+
+
+def _pack_points(pts):
+    """List of python extended points -> batched JAX point (affine-normalized)."""
+    coords = []
+    for pt in pts:
+        x, y = ref._ext_to_affine(pt)
+        coords.append((x, y, 1, (x * y) % P))
+    arrs = []
+    for c in range(4):
+        arrs.append(
+            jnp.stack([jnp.asarray(F.from_int(p[c])) for p in coords], axis=1)
+        )
+    return tuple(arrs)
+
+
+def _affine_of(jp):
+    """Batched JAX point -> list of affine tuples via the oracle's math."""
+    X, Y, Z, _ = [np.asarray(F.freeze(a)) for a in jp]
+    out = []
+    for i in range(X.shape[1]):
+        x, y, z = F.to_int(X[:, i]), F.to_int(Y[:, i]), F.to_int(Z[:, i])
+        zi = pow(z, P - 2, P)
+        out.append(((x * zi) % P, (y * zi) % P))
+    return out
+
+
+j_add = jax.jit(C.add)
+j_dbl = jax.jit(C.dbl)
+j_shamir = jax.jit(C.shamir)
+j_decompress = jax.jit(C.decompress)
+j_compress = jax.jit(C.compress)
+
+
+def test_add_dbl_matches_oracle():
+    ps = _rand_points(8)
+    qs = _rand_points(8)
+    got = _affine_of(j_add(_pack_points(ps), _pack_points(qs)))
+    want = [ref._ext_to_affine(ref._ext_add(p, q)) for p, q in zip(ps, qs)]
+    assert got == want
+    got = _affine_of(j_dbl(_pack_points(ps)))
+    want = [ref._ext_to_affine(ref._ext_add(p, p)) for p in ps]
+    assert got == want
+
+
+def test_add_identity_and_self():
+    """Completeness: P + (-P), P + P, P + identity via the unified formula."""
+    ps = _rand_points(4)
+    jp = _pack_points(ps)
+    s = j_add(jp, jax.jit(C.neg)(jp))
+    assert bool(np.asarray(C.is_identity(s)).all())
+    ident = C.identity(4)
+    got = _affine_of(j_add(jp, ident))
+    assert got == [ref._ext_to_affine(p) for p in ps]
+    got = _affine_of(j_add(jp, jp))
+    assert got == [ref._ext_to_affine(ref._ext_add(p, p)) for p in ps]
+
+
+def test_decompress_compress_roundtrip():
+    ps = _rand_points(8)
+    encs = np.stack(
+        [np.frombuffer(ref._encode_point(*ref._ext_to_affine(p)), np.uint8) for p in ps]
+    )
+    valid, jp = j_decompress(jnp.asarray(encs))
+    assert bool(np.asarray(valid).all())
+    assert _affine_of(jp) == [ref._ext_to_affine(p) for p in ps]
+    back = np.asarray(j_compress(jp))
+    assert (back == encs).all()
+
+
+def test_decompress_zip215_semantics():
+    def with_sign(y: int) -> bytes:
+        b = bytearray(y.to_bytes(32, "little"))
+        b[31] |= 0x80
+        return bytes(b)
+
+    cases = [
+        ref._encode_point(0, 1),  # canonical identity (y=1)
+        (1 + P).to_bytes(32, "little"),  # non-canonical y = 1+p (accepted)
+        with_sign(1),  # x=0 with sign bit set ("negative zero", accepted)
+        (0).to_bytes(32, "little"),  # y=0: order-4 point (sqrt(-1), 0)
+        P.to_bytes(32, "little"),  # non-canonical y = 0 + p (accepted)
+        with_sign(P),  # non-canonical y=p AND sign bit (accepted, x flipped)
+    ]
+    # y with no valid x (non-square) and a few small valid ys: oracle decides
+    cases += [y.to_bytes(32, "little") for y in range(2, 6)]
+    want = [ref._decode_point(e, zip215=True) for e in cases]
+    encs = np.stack([np.frombuffer(e, np.uint8) for e in cases])
+    valid, jp = j_decompress(jnp.asarray(encs))
+    assert list(np.asarray(valid)) == [w is not None for w in want]
+    assert want[0] is not None and want[1] is not None and want[2] is not None
+    assert want[3] is not None and want[4] is not None and want[5] is not None
+    # oracle agreement on decoded coords for the valid ones
+    aff = _affine_of(jp)
+    for i, w in enumerate(want):
+        if w is not None:
+            assert aff[i] == w, i
+
+
+def test_shamir_double_scalar():
+    n = 4
+    pts = _rand_points(n)
+    ss = [int.from_bytes(rng.bytes(32), "little") % ref.L for _ in range(n)]
+    ks = [int.from_bytes(rng.bytes(32), "little") % ref.L for _ in range(n)]
+    jp = _pack_points(pts)
+    r = j_shamir(
+        jnp.asarray(C.scalar_windows(ss)), jnp.asarray(C.scalar_windows(ks)), jp
+    )
+    want = [
+        ref._ext_to_affine(
+            ref._ext_add(ref._ext_scalar_mul(s, ref.B_POINT), ref._ext_scalar_mul(k, p))
+        )
+        for s, k, p in zip(ss, ks, pts)
+    ]
+    assert _affine_of(r) == want
+
+
+def test_shamir_zero_scalars():
+    n = 2
+    pts = _rand_points(n)
+    jp = _pack_points(pts)
+    z = jnp.zeros((n, 64), jnp.int32)
+    r = j_shamir(z, z, jp)
+    assert bool(np.asarray(C.is_identity(r)).all())
