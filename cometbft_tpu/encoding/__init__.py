@@ -1,0 +1,3 @@
+"""Wire encodings: minimal protobuf writer/reader for canonical sign-bytes,
+storage, and framing. Hand-rolled (no generated code) — the handful of
+consensus-critical messages are encoded explicitly for bit-exactness."""

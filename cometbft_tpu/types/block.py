@@ -1,0 +1,293 @@
+"""Blocks: Header, Commit, CommitSig, Data.
+
+Hash rules (behavior parity with reference types/block.go):
+- Header.Hash = merkle over the 14 proto-encoded header fields, primitives
+  wrapped in gogo wrapper messages (reference types/block.go:438-473 +
+  types/encoding_helper.go cdcEncode); empty primitives hash as nil leaves.
+- Commit.Hash = merkle over proto-encoded CommitSigs (types/block.go:835).
+- Data.Hash = merkle over SHA-256 tx hashes (types/tx.go Txs.Hash).
+- Commit.VoteSignBytes rebuilds the canonical precommit each signer signed
+  (types/block.go:879): per-validator timestamp and flag-dependent BlockID.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from ..crypto import merkle
+from ..crypto.keys import tmhash
+from ..encoding import proto as pb
+from .basic import BlockID, Timestamp, ZERO_BLOCK_ID, ZERO_TIME
+from .vote import SignedMsgType, canonical_vote_bytes
+
+MAX_HEADER_BYTES = 626
+BLOCK_PART_SIZE_BYTES = 65536  # reference types/part_set.go BlockPartSizeBytes
+
+
+class BlockIDFlag(enum.IntEnum):
+    UNKNOWN = 0
+    ABSENT = 1
+    COMMIT = 2
+    NIL = 3
+
+
+def _wrap_string(s: str) -> bytes:
+    return pb.f_string(1, s) if s else b""
+
+
+def _wrap_int64(v: int) -> bytes:
+    return pb.f_varint(1, v) if v else b""
+
+
+def _wrap_bytes(b: bytes) -> bytes:
+    return pb.f_bytes(1, b) if b else b""
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """Version info (reference proto cometbft/version/v1 Consensus)."""
+
+    block: int = 11  # reference version/version.go BlockProtocol
+    app: int = 0
+
+    def encode(self) -> bytes:
+        return pb.f_varint(1, self.block) + pb.f_varint(2, self.app)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Consensus":
+        d = pb.fields_to_dict(buf)
+        return cls(int(d.get(1, 0)), int(d.get(2, 0)))
+
+
+@dataclass
+class Header:
+    version: Consensus = field(default_factory=Consensus)
+    chain_id: str = ""
+    height: int = 0
+    time: Timestamp = ZERO_TIME
+    last_block_id: BlockID = ZERO_BLOCK_ID
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes | None:
+        if not self.validators_hash:
+            return None
+        return merkle.hash_from_byte_slices(
+            [
+                self.version.encode(),
+                _wrap_string(self.chain_id),
+                _wrap_int64(self.height),
+                self.time.encode(),
+                self.last_block_id.encode(),
+                _wrap_bytes(self.last_commit_hash),
+                _wrap_bytes(self.data_hash),
+                _wrap_bytes(self.validators_hash),
+                _wrap_bytes(self.next_validators_hash),
+                _wrap_bytes(self.consensus_hash),
+                _wrap_bytes(self.app_hash),
+                _wrap_bytes(self.last_results_hash),
+                _wrap_bytes(self.evidence_hash),
+                _wrap_bytes(self.proposer_address),
+            ]
+        )
+
+    def encode(self) -> bytes:
+        return (
+            pb.f_embedded(1, self.version.encode())
+            + pb.f_string(2, self.chain_id)
+            + pb.f_varint(3, self.height)
+            + pb.f_embedded(4, self.time.encode())
+            + pb.f_embedded(5, self.last_block_id.encode())
+            + pb.f_bytes(6, self.last_commit_hash)
+            + pb.f_bytes(7, self.data_hash)
+            + pb.f_bytes(8, self.validators_hash)
+            + pb.f_bytes(9, self.next_validators_hash)
+            + pb.f_bytes(10, self.consensus_hash)
+            + pb.f_bytes(11, self.app_hash)
+            + pb.f_bytes(12, self.last_results_hash)
+            + pb.f_bytes(13, self.evidence_hash)
+            + pb.f_bytes(14, self.proposer_address)
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Header":
+        d = pb.fields_to_dict(buf)
+        return cls(
+            version=Consensus.decode(bytes(d.get(1, b""))),
+            chain_id=bytes(d.get(2, b"")).decode("utf-8"),
+            height=pb.to_i64(d.get(3, 0)),
+            time=Timestamp.decode(bytes(d.get(4, b""))),
+            last_block_id=BlockID.decode(bytes(d.get(5, b""))),
+            last_commit_hash=bytes(d.get(6, b"")),
+            data_hash=bytes(d.get(7, b"")),
+            validators_hash=bytes(d.get(8, b"")),
+            next_validators_hash=bytes(d.get(9, b"")),
+            consensus_hash=bytes(d.get(10, b"")),
+            app_hash=bytes(d.get(11, b"")),
+            last_results_hash=bytes(d.get(12, b"")),
+            evidence_hash=bytes(d.get(13, b"")),
+            proposer_address=bytes(d.get(14, b"")),
+        )
+
+
+@dataclass
+class CommitSig:
+    """One validator's slot in a commit (reference types/block.go:594)."""
+
+    block_id_flag: BlockIDFlag = BlockIDFlag.ABSENT
+    validator_address: bytes = b""
+    timestamp: Timestamp = ZERO_TIME
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls()
+
+    def is_absent(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.ABSENT
+
+    def is_commit(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def effective_block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this slot's vote was cast for
+        (reference types/block.go CommitSig.BlockID)."""
+        if self.block_id_flag == BlockIDFlag.COMMIT:
+            return commit_block_id
+        return ZERO_BLOCK_ID
+
+    def encode(self) -> bytes:
+        return (
+            pb.f_varint(1, int(self.block_id_flag))
+            + pb.f_bytes(2, self.validator_address)
+            + pb.f_embedded(3, self.timestamp.encode())
+            + pb.f_bytes(4, self.signature)
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "CommitSig":
+        d = pb.fields_to_dict(buf)
+        return cls(
+            block_id_flag=BlockIDFlag(int(d.get(1, 0))),
+            validator_address=bytes(d.get(2, b"")),
+            timestamp=Timestamp.decode(bytes(d.get(3, b""))),
+            signature=bytes(d.get(4, b"")),
+        )
+
+
+@dataclass
+class Commit:
+    """+2/3 precommit evidence for a block (reference types/block.go:835)."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = ZERO_BLOCK_ID
+    signatures: list[CommitSig] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([cs.encode() for cs in self.signatures])
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Rebuild the canonical precommit bytes validator idx signed
+        (reference types/block.go:879)."""
+        cs = self.signatures[idx]
+        return canonical_vote_bytes(
+            SignedMsgType.PRECOMMIT,
+            self.height,
+            self.round,
+            cs.effective_block_id(self.block_id),
+            cs.timestamp,
+            chain_id,
+        )
+
+    def encode(self) -> bytes:
+        out = (
+            pb.f_varint(1, self.height)
+            + pb.f_varint(2, self.round)
+            + pb.f_embedded(3, self.block_id.encode())
+        )
+        for cs in self.signatures:
+            out += pb.f_embedded(4, cs.encode())
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Commit":
+        height = round_ = 0
+        block_id = ZERO_BLOCK_ID
+        sigs = []
+        for f, _, v in pb.parse_fields(buf):
+            if f == 1:
+                height = pb.to_i64(v)
+            elif f == 2:
+                round_ = pb.to_i64(v)
+            elif f == 3:
+                block_id = BlockID.decode(bytes(v))
+            elif f == 4:
+                sigs.append(CommitSig.decode(bytes(v)))
+        return cls(height, round_, block_id, sigs)
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return tmhash(tx)
+
+
+@dataclass
+class Data:
+    txs: list[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([tx_hash(t) for t in self.txs])
+
+    def encode(self) -> bytes:
+        out = b""
+        for t in self.txs:
+            out += pb.f_bytes(1, t, emit_empty=True)
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Data":
+        return cls([bytes(v) for f, _, v in pb.parse_fields(buf) if f == 1])
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data = field(default_factory=Data)
+    evidence: list = field(default_factory=list)
+    last_commit: Commit = field(default_factory=Commit)
+
+    def hash(self) -> bytes | None:
+        return self.header.hash()
+
+    def encode(self) -> bytes:
+        ev_payload = b""  # evidence encoding lands with the evidence pool
+        return (
+            pb.f_embedded(1, self.header.encode())
+            + pb.f_embedded(2, self.data.encode())
+            + pb.f_embedded(3, ev_payload)
+            + pb.f_embedded_opt(4, self.last_commit.encode() if self.last_commit else None)
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Block":
+        d = pb.fields_to_dict(buf)
+        return cls(
+            header=Header.decode(bytes(d.get(1, b""))),
+            data=Data.decode(bytes(d.get(2, b""))),
+            evidence=[],
+            last_commit=Commit.decode(bytes(d.get(4, b""))) if 4 in d else Commit(),
+        )

@@ -1,0 +1,190 @@
+"""Commit verification — the consensus hot path feeding the TPU data plane.
+
+Behavior parity with reference types/validation.go:
+- VerifyCommit (:26): checks EVERY non-absent signature (LastCommit reward
+  accuracy), tallying only BlockIDFlag.COMMIT votes toward the +2/3 check.
+- VerifyCommitLight (:61): verifies only COMMIT votes, succeeds on +2/3.
+- VerifyCommitLightTrusting (:125): validator lookup by address against a
+  *different* (trusted) set, threshold = trust_level fraction of its power.
+- Batch path (:214): any commit with >= 2 signatures goes through the
+  BatchVerifier (the TPU kernel); on batch failure the per-signature
+  validity bitmap pinpoints the first bad signature — the reference has to
+  re-scan singly (:304-311), we get the bitmap for free from the per-lane
+  kernel.
+"""
+
+from __future__ import annotations
+
+from ..crypto import ed25519
+from ..crypto.keys import PubKey
+from .basic import BlockID
+from .block import BlockIDFlag, Commit
+from .validator_set import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2
+
+
+class CommitError(Exception):
+    pass
+
+
+class ErrInvalidCommitHeight(CommitError):
+    pass
+
+
+class ErrInvalidCommitSize(CommitError):
+    pass
+
+
+class ErrInvalidBlockID(CommitError):
+    pass
+
+
+class ErrInvalidSignature(CommitError):
+    pass
+
+
+class ErrNotEnoughVotingPower(CommitError):
+    pass
+
+
+def _verify_items(items, backend: str):
+    """items: list of (pubkey, msg, sig, power_if_counted). Returns tally.
+
+    Raises ErrInvalidSignature naming the first invalid index.
+    """
+    if len(items) >= BATCH_VERIFY_THRESHOLD:
+        bv = ed25519.Ed25519BatchVerifier(backend=backend)
+        addable = True
+        for pub, msg, sig, _ in items:
+            if not bv.add(pub, msg, sig):
+                addable = False
+        ok, bits = (False, None)
+        if addable:
+            ok, bits = bv.verify()
+        if not ok:
+            if bits is not None:
+                for i, b in enumerate(bits):
+                    if not b:
+                        raise ErrInvalidSignature(f"invalid signature at index {i}")
+            # fall back to singles to locate the failure
+            for i, (pub, msg, sig, _) in enumerate(items):
+                if not pub.verify_signature(msg, sig):
+                    raise ErrInvalidSignature(f"invalid signature at index {i}")
+            raise ErrInvalidSignature("batch verification failed")
+    else:
+        for i, (pub, msg, sig, _) in enumerate(items):
+            if not pub.verify_signature(msg, sig):
+                raise ErrInvalidSignature(f"invalid signature at index {i}")
+    return sum(p for _, _, _, p in items)
+
+
+def _check_commit_basics(vals: ValidatorSet, commit: Commit, height: int, block_id: BlockID):
+    if commit.height != height:
+        raise ErrInvalidCommitHeight(f"expected height {height}, got {commit.height}")
+    if commit.block_id != block_id:
+        raise ErrInvalidBlockID("commit is for a different block")
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    backend: str = "tpu",
+) -> None:
+    """Full verification: every non-absent signature checked
+    (reference types/validation.go:21-53)."""
+    _check_commit_basics(vals, commit, height, block_id)
+    if len(vals) != commit.size():
+        raise ErrInvalidCommitSize(
+            f"validator set size {len(vals)} != commit size {commit.size()}"
+        )
+    items = []
+    tally_power = 0
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        val = vals.get_by_index(idx)
+        if val.address != cs.validator_address:
+            raise ErrInvalidSignature(
+                f"address mismatch at index {idx}"
+            )
+        counted = val.voting_power if cs.is_commit() else 0
+        items.append((val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature, counted))
+    tally_power = _verify_items(items, backend)
+    threshold = vals.total_voting_power() * 2 // 3
+    if tally_power <= threshold:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tally_power} <= threshold {threshold}"
+        )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    backend: str = "tpu",
+    verify_all_signatures: bool = False,
+) -> None:
+    """Verify only COMMIT votes; succeed on +2/3
+    (reference types/validation.go:61; AllSignatures variant :136)."""
+    _check_commit_basics(vals, commit, height, block_id)
+    if len(vals) != commit.size():
+        raise ErrInvalidCommitSize(
+            f"validator set size {len(vals)} != commit size {commit.size()}"
+        )
+    items = []
+    threshold = vals.total_voting_power() * 2 // 3
+    running = 0
+    for idx, cs in enumerate(commit.signatures):
+        if not cs.is_commit():
+            continue
+        val = vals.get_by_index(idx)
+        if val.address != cs.validator_address:
+            raise ErrInvalidSignature(f"address mismatch at index {idx}")
+        items.append((val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature, val.voting_power))
+        running += val.voting_power
+        if not verify_all_signatures and running > threshold:
+            break
+    tally = _verify_items(items, backend)
+    if tally <= threshold:
+        raise ErrNotEnoughVotingPower(f"tallied {tally} <= threshold {threshold}")
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: tuple[int, int] = (1, 3),
+    backend: str = "tpu",
+    verify_all_signatures: bool = False,
+) -> None:
+    """Trusted-set verification by address with fractional threshold
+    (reference types/validation.go:125; AllSignatures variant :124 in
+    evidence verify). Skips validators unknown to the trusted set; guards
+    against double-counting a validator appearing at two indices."""
+    num, den = trust_level
+    if den <= 0 or num < 0 or num > den:
+        raise ValueError("invalid trust level")
+    threshold = vals.total_voting_power() * num // den
+    seen: set[bytes] = set()
+    items = []
+    running = 0
+    for idx, cs in enumerate(commit.signatures):
+        if not cs.is_commit():
+            continue
+        _, val = vals.get_by_address(cs.validator_address)
+        if val is None or val.address in seen:
+            continue
+        seen.add(val.address)
+        items.append((val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature, val.voting_power))
+        running += val.voting_power
+        if not verify_all_signatures and running > threshold:
+            break
+    tally = _verify_items(items, backend)
+    if tally <= threshold:
+        raise ErrNotEnoughVotingPower(f"tallied {tally} <= threshold {threshold}")

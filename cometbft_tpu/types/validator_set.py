@@ -1,0 +1,269 @@
+"""Validators and the proposer-priority validator set.
+
+Behavior parity with reference types/validator_set.go: ordering by
+(voting power desc, address asc), proposer rotation via priority queue
+(IncrementProposerPriority :116, rescale window :143, avg-centering :227),
+merkle hash over SimpleValidator encodings (:348), and ABCI update
+application with the -(P + P/8) new-validator priority penalty (:659).
+Arithmetic is int64-clipped exactly like the reference (safeAddClip /
+truncated division), since priorities are consensus-visible state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import merkle
+from ..crypto.keys import PubKey
+from ..encoding import proto as pb
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+MAX_TOTAL_VOTING_POWER = I64_MAX // 8
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+
+
+def _clip(v: int) -> int:
+    return max(I64_MIN, min(I64_MAX, v))
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go-style int64 division (truncates toward zero)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+def encode_pub_key(pk: PubKey) -> bytes:
+    """crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2."""
+    tag = pk.type_tag()
+    if "Ed25519" in tag:
+        return pb.f_bytes(1, pk.bytes(), emit_empty=True)
+    if "Secp256k1" in tag:
+        return pb.f_bytes(2, pk.bytes(), emit_empty=True)
+    raise ValueError(f"unsupported key type {tag}")
+
+
+@dataclass
+class Validator:
+    address: bytes
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+
+    @classmethod
+    def from_pub_key(cls, pk: PubKey, power: int) -> "Validator":
+        return cls(pk.address(), pk, power)
+
+    def simple_encode(self) -> bytes:
+        """SimpleValidator proto (pubkey + power), the hashing encoding."""
+        return pb.f_embedded(1, encode_pub_key(self.pub_key)) + pb.f_varint(
+            2, self.voting_power
+        )
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        if self.address < other.address:
+            return self
+        if self.address > other.address:
+            return other
+        raise ValueError("validators with equal addresses")
+
+    def copy(self) -> "Validator":
+        return Validator(
+            self.address, self.pub_key, self.voting_power, self.proposer_priority
+        )
+
+
+def _sort_key(v: Validator):
+    # voting power desc, then address asc
+    return (-v.voting_power, v.address)
+
+
+class ValidatorSet:
+    """Ordered validator set with proposer rotation."""
+
+    def __init__(self, validators: list[Validator], increment_first: bool = True):
+        if not validators:
+            raise ValueError("validator set must not be empty")
+        vals = sorted((v.copy() for v in validators), key=_sort_key)
+        addrs = [v.address for v in vals]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError("duplicate validator address")
+        self.validators: list[Validator] = vals
+        self.proposer: Validator | None = None
+        self._total_power: int | None = None
+        self.total_voting_power()  # validates the cap
+        if increment_first:
+            self.increment_proposer_priority(1)
+
+    # --- queries ---
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def total_voting_power(self) -> int:
+        if self._total_power is None:
+            total = 0
+            for v in self.validators:
+                total += v.voting_power
+                if total > MAX_TOTAL_VOTING_POWER:
+                    raise ValueError("total voting power exceeds cap")
+            self._total_power = total
+        return self._total_power
+
+    def get_by_address(self, addr: bytes) -> tuple[int, Validator | None]:
+        for i, v in enumerate(self.validators):
+            if v.address == addr:
+                return i, v
+        return -1, None
+
+    def get_by_index(self, idx: int) -> Validator | None:
+        if 0 <= idx < len(self.validators):
+            return self.validators[idx]
+        return None
+
+    def has_address(self, addr: bytes) -> bool:
+        return self.get_by_address(addr)[0] >= 0
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [v.simple_encode() for v in self.validators]
+        )
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet.__new__(ValidatorSet)
+        vs.validators = [v.copy() for v in self.validators]
+        vs.proposer = self.proposer.copy() if self.proposer else None
+        vs._total_power = self._total_power
+        return vs
+
+    # --- proposer priority machinery ---
+
+    def _compute_avg_priority(self) -> int:
+        n = len(self.validators)
+        s = sum(v.proposer_priority for v in self.validators)
+        # Go big.Int Euclidean Div (floor for positive divisor)
+        return s // n
+
+    def _shift_by_avg(self):
+        avg = self._compute_avg_priority()
+        for v in self.validators:
+            v.proposer_priority = _clip(v.proposer_priority - avg)
+
+    def rescale_priorities(self, diff_max: int):
+        if diff_max <= 0:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        diff = max(prios) - min(prios)
+        if diff < 0:
+            diff = -diff
+        ratio = (diff + diff_max - 1) // diff_max
+        if diff > diff_max:
+            for v in self.validators:
+                v.proposer_priority = _trunc_div(v.proposer_priority, ratio)
+
+    def _increment_once(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority = _clip(v.proposer_priority + v.voting_power)
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        mostest.proposer_priority = _clip(
+            mostest.proposer_priority - self.total_voting_power()
+        )
+        return mostest
+
+    def increment_proposer_priority(self, times: int):
+        if times <= 0:
+            raise ValueError("times must be positive")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self.rescale_priorities(diff_max)
+        self._shift_by_avg()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_once()
+        self.proposer = proposer
+
+    def get_proposer(self) -> Validator:
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer
+
+    def _find_proposer(self) -> Validator:
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        return mostest
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        vs = self.copy()
+        vs.increment_proposer_priority(times)
+        return vs
+
+    # --- updates (ABCI validator changes) ---
+
+    def update_with_change_set(self, changes: list[Validator]):
+        """Apply power updates / removals (power 0), reference :659.
+
+        New validators enter with priority -(P' + P'/8) where P' is the
+        total power after the update; priorities are then recentered and
+        rescaled into the window.
+        """
+        if not changes:
+            return
+        by_addr = {}
+        for c in changes:
+            if c.address in by_addr:
+                raise ValueError("duplicate address in change set")
+            if c.voting_power < 0:
+                raise ValueError("negative voting power")
+            by_addr[c.address] = c
+
+        removals = {a for a, c in by_addr.items() if c.voting_power == 0}
+        for a in removals:
+            if not self.has_address(a):
+                raise ValueError("removing non-existent validator")
+
+        kept = [v for v in self.validators if v.address not in removals]
+        updated = []
+        new_addrs = []
+        for v in kept:
+            c = by_addr.get(v.address)
+            if c is not None and c.voting_power != 0:
+                nv = v.copy()
+                nv.voting_power = c.voting_power
+                nv.pub_key = c.pub_key
+                updated.append(nv)
+            else:
+                updated.append(v.copy())
+        existing = {v.address for v in updated}
+        for a, c in by_addr.items():
+            if c.voting_power > 0 and a not in existing:
+                nv = c.copy()
+                updated.append(nv)
+                new_addrs.append(a)
+
+        if not updated:
+            raise ValueError("applying changes would empty the validator set")
+        total = 0
+        for v in updated:
+            total += v.voting_power
+            if total > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("total voting power exceeds cap after update")
+
+        penalty = -_clip(total + total // 8)
+        for v in updated:
+            if v.address in set(new_addrs):
+                v.proposer_priority = penalty
+
+        self.validators = sorted(updated, key=_sort_key)
+        self._total_power = None
+        self.total_voting_power()
+        # recenter + rescale into the priority window
+        self._shift_by_avg()
+        self.rescale_priorities(
+            PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        )

@@ -1,0 +1,1 @@
+"""Utilities: test/bench factories, service lifecycle, WAL primitives."""

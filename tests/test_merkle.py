@@ -1,0 +1,43 @@
+"""Merkle tree tests (RFC-6962 style, reference crypto/merkle behavior)."""
+
+import hashlib
+
+from cometbft_tpu.crypto import merkle
+
+
+def sha(b):
+    return hashlib.sha256(b).digest()
+
+
+def test_empty_and_single():
+    assert merkle.hash_from_byte_slices([]) == sha(b"")
+    assert merkle.hash_from_byte_slices([b"x"]) == sha(b"\x00x")
+
+
+def test_two_and_three_leaves():
+    l0, l1, l2 = sha(b"\x00a"), sha(b"\x00b"), sha(b"\x00c")
+    assert merkle.hash_from_byte_slices([b"a", b"b"]) == sha(b"\x01" + l0 + l1)
+    # split point for 3 is 2: inner(inner(l0,l1), l2)
+    want = sha(b"\x01" + sha(b"\x01" + l0 + l1) + l2)
+    assert merkle.hash_from_byte_slices([b"a", b"b", b"c"]) == want
+
+
+def test_proofs_verify_and_reject():
+    items = [b"alpha", b"beta", b"gamma", b"delta", b"epsilon"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, item in enumerate(items):
+        assert proofs[i].verify(root, item)
+        assert not proofs[i].verify(root, item + b"!")
+        assert not proofs[i].verify(sha(b"other"), item)
+    # proof for one index must not verify another's leaf
+    assert not proofs[0].verify(root, items[1])
+
+
+def test_proof_sizes():
+    for n in [1, 2, 3, 4, 7, 8, 9, 33]:
+        items = [bytes([i]) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        for p in proofs:
+            assert p.total == n
+            assert p.compute_root() == root

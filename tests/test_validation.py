@@ -1,0 +1,92 @@
+"""VerifyCommit family tests over real signed commits (TPU batch path)."""
+
+import pytest
+
+from cometbft_tpu.types import validation
+from cometbft_tpu.types.block import BlockIDFlag
+from cometbft_tpu.utils import factories as fx
+
+CHAIN = "test-chain"
+
+
+def _setup(n=6, powers=None, absent=None, height=5):
+    signers = fx.make_signers(n, seed=11)
+    vals = fx.make_validator_set(signers, powers)
+    by_addr = {s.address(): s for s in signers}
+    bid = fx.make_block_id(b"blk-%d" % height)
+    commit = fx.make_commit(CHAIN, height, 0, bid, vals, by_addr, absent=absent)
+    return signers, vals, bid, commit
+
+
+def test_verify_commit_ok():
+    _, vals, bid, commit = _setup()
+    validation.verify_commit(CHAIN, vals, bid, 5, commit)
+    validation.verify_commit_light(CHAIN, vals, bid, 5, commit)
+    validation.verify_commit_light_trusting(CHAIN, vals, commit)
+
+
+def test_verify_commit_wrong_height_and_blockid():
+    _, vals, bid, commit = _setup()
+    with pytest.raises(validation.ErrInvalidCommitHeight):
+        validation.verify_commit(CHAIN, vals, bid, 6, commit)
+    with pytest.raises(validation.ErrInvalidBlockID):
+        validation.verify_commit(CHAIN, vals, fx.make_block_id(b"other"), 5, commit)
+
+
+def test_verify_commit_bad_signature_located():
+    _, vals, bid, commit = _setup()
+    sig = bytearray(commit.signatures[2].signature)
+    sig[1] ^= 0xFF
+    commit.signatures[2].signature = bytes(sig)
+    with pytest.raises(validation.ErrInvalidSignature) as ei:
+        validation.verify_commit(CHAIN, vals, bid, 5, commit)
+    assert "index 2" in str(ei.value)
+
+
+def test_verify_commit_absent_below_threshold():
+    # 6 validators, 3 absent: tally 30/60 <= 2/3 threshold -> fail
+    _, vals, bid, commit = _setup(absent={0, 1, 2})
+    with pytest.raises(validation.ErrNotEnoughVotingPower):
+        validation.verify_commit(CHAIN, vals, bid, 5, commit)
+
+
+def test_verify_commit_absent_above_threshold():
+    # 1 absent of 6: 50/60 > 2/3 -> ok
+    _, vals, bid, commit = _setup(absent={4})
+    validation.verify_commit(CHAIN, vals, bid, 5, commit)
+    validation.verify_commit_light(CHAIN, vals, bid, 5, commit)
+
+
+def test_nil_votes_verified_but_not_counted():
+    _, vals, bid, commit = _setup()
+    # flip one COMMIT slot to NIL: its signature no longer matches (it signed
+    # the block id), so full verification must fail on that slot...
+    commit.signatures[1].block_id_flag = BlockIDFlag.NIL
+    with pytest.raises(validation.ErrInvalidSignature):
+        validation.verify_commit(CHAIN, vals, bid, 5, commit)
+    # ...but light verification skips non-COMMIT sigs entirely and the
+    # remaining 5/6 power still clears 2/3
+    validation.verify_commit_light(CHAIN, vals, bid, 5, commit)
+
+
+def test_verify_commit_size_mismatch():
+    _, vals, bid, commit = _setup()
+    commit.signatures.append(commit.signatures[0])
+    with pytest.raises(validation.ErrInvalidCommitSize):
+        validation.verify_commit(CHAIN, vals, bid, 5, commit)
+
+
+def test_light_trusting_subset_overlap():
+    # trusted set = 6 validators; commit from a 6-val set sharing 4 members
+    signers_a = fx.make_signers(6, seed=11)
+    vals_a = fx.make_validator_set(signers_a)
+    signers_b = signers_a[:4] + fx.make_signers(2, seed=99)
+    vals_b = fx.make_validator_set(signers_b)
+    by_addr = {s.address(): s for s in signers_b}
+    bid = fx.make_block_id(b"lc")
+    commit = fx.make_commit(CHAIN, 9, 0, bid, vals_b, by_addr)
+    # overlap power 40/60 > 1/3 of trusted set -> trusting check passes
+    validation.verify_commit_light_trusting(CHAIN, vals_a, commit, (1, 3))
+    # demanding >2/3 overlap: 40 > 40? no -> fails
+    with pytest.raises(validation.ErrNotEnoughVotingPower):
+        validation.verify_commit_light_trusting(CHAIN, vals_a, commit, (2, 3))

@@ -1,0 +1,99 @@
+"""ValidatorSet tests: ordering, proposer rotation, updates, hashing."""
+
+import pytest
+
+from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey
+from cometbft_tpu.types import Validator, ValidatorSet
+
+
+def _mk_vals(powers):
+    out = []
+    for i, p in enumerate(powers):
+        pk = Ed25519PrivKey(bytes([i + 1]) * 32)
+        out.append(Validator.from_pub_key(pk.pub_key(), p))
+    return out
+
+
+def test_ordering_power_desc_then_address():
+    vals = _mk_vals([5, 20, 10, 20])
+    vs = ValidatorSet(vals)
+    powers = [v.voting_power for v in vs.validators]
+    assert powers == sorted(powers, reverse=True)
+    # equal powers tie-break by address ascending
+    twenties = [v for v in vs.validators if v.voting_power == 20]
+    assert twenties[0].address < twenties[1].address
+
+
+def test_round_robin_equal_powers():
+    vs = ValidatorSet(_mk_vals([10, 10, 10]))
+    seen = []
+    for _ in range(6):
+        seen.append(vs.get_proposer().address)
+        vs.increment_proposer_priority(1)
+    assert seen[:3] == seen[3:6]
+    assert len(set(seen[:3])) == 3
+
+
+def test_proposer_frequency_proportional_to_power():
+    vs = ValidatorSet(_mk_vals([1, 2, 3]))
+    counts = {}
+    for _ in range(600):
+        addr = vs.get_proposer().address
+        counts[addr] = counts.get(addr, 0) + 1
+        vs.increment_proposer_priority(1)
+    by_power = {v.address: v.voting_power for v in vs.validators}
+    freq = sorted((counts[a], by_power[a]) for a in counts)
+    assert freq[0][1] == 1 and freq[-1][1] == 3
+    assert abs(freq[0][0] - 100) <= 2 and abs(freq[-1][0] - 300) <= 2
+
+
+def test_hash_changes_with_membership_and_power():
+    vs1 = ValidatorSet(_mk_vals([10, 10]))
+    vs2 = ValidatorSet(_mk_vals([10, 11]))
+    vs3 = ValidatorSet(_mk_vals([10, 10, 10]))
+    assert vs1.hash() != vs2.hash() != vs3.hash()
+    assert vs1.hash() == ValidatorSet(_mk_vals([10, 10])).hash()
+
+
+def test_update_with_change_set():
+    vals = _mk_vals([10, 20, 30])
+    vs = ValidatorSet(vals)
+    # change power of one, remove one, add one
+    newcomer = _mk_vals([1, 1, 1, 40])[3]
+    changes = [
+        Validator(vals[0].address, vals[0].pub_key, 15),  # power change
+        Validator(vals[1].address, vals[1].pub_key, 0),  # removal
+        newcomer,  # addition
+    ]
+    vs.update_with_change_set(changes)
+    assert len(vs) == 3
+    assert vs.total_voting_power() == 15 + 30 + 40
+    idx, v = vs.get_by_address(vals[0].address)
+    assert v.voting_power == 15
+    assert not vs.has_address(vals[1].address)
+    # newcomer entered with the priority penalty (lowest priority)
+    _, nv = vs.get_by_address(newcomer.address)
+    assert nv.proposer_priority <= min(
+        v.proposer_priority for v in vs.validators
+    ) + 1
+
+
+def test_update_rejects_bad_changes():
+    vals = _mk_vals([10, 20])
+    vs = ValidatorSet(vals)
+    with pytest.raises(ValueError):
+        vs.update_with_change_set(
+            [Validator(b"\x99" * 20, vals[0].pub_key, 0)]
+        )  # removing unknown
+    with pytest.raises(ValueError):
+        vs.update_with_change_set(
+            [
+                Validator(vals[0].address, vals[0].pub_key, 5),
+                Validator(vals[0].address, vals[0].pub_key, 6),
+            ]
+        )  # duplicate
+
+
+def test_empty_set_rejected():
+    with pytest.raises(ValueError):
+        ValidatorSet([])
