@@ -1,0 +1,24 @@
+"""ABCI: the application boundary (reference abci/types/application.go:9-35).
+
+14 methods in 4 groups — Info/Query; CheckTx (mempool); InitChain/
+PrepareProposal/ProcessProposal/FinalizeBlock/ExtendVote/
+VerifyVoteExtension/Commit (consensus); ListSnapshots/OfferSnapshot/
+LoadSnapshotChunk/ApplySnapshotChunk (state sync).
+"""
+
+from .types import (  # noqa: F401
+    Application,
+    CheckTxResult,
+    ExecTxResult,
+    FinalizeBlockRequest,
+    FinalizeBlockResponse,
+    InfoResponse,
+    InitChainRequest,
+    InitChainResponse,
+    ProposalStatus,
+    QueryResponse,
+    Snapshot,
+    ValidatorUpdate,
+)
+from .client import LocalClient  # noqa: F401
+from .kvstore import KVStoreApp  # noqa: F401

@@ -1,0 +1,123 @@
+"""In-memory key=value example app (reference abci/example/kvstore).
+
+Tx format: b"key=value". App hash commits to the store's contents +
+height so every honest node agrees. Also the universal test app, like the
+reference's kvstore doubles as the e2e app base.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .types import (
+    Application,
+    CheckTxResult,
+    ExecTxResult,
+    FinalizeBlockRequest,
+    FinalizeBlockResponse,
+    InfoResponse,
+    InitChainRequest,
+    InitChainResponse,
+    ProposalStatus,
+    QueryResponse,
+    ValidatorUpdate,
+)
+
+VALIDATOR_PREFIX = b"val:"
+
+
+class KVStoreApp(Application):
+    def __init__(self):
+        self.store: dict[bytes, bytes] = {}
+        self.pending: dict[bytes, bytes] = {}
+        self.height = 0
+        self.app_hash = b"\x00" * 32
+        self.val_updates: list[ValidatorUpdate] = []
+
+    # --- helpers ---
+    @staticmethod
+    def _parse(tx: bytes) -> tuple[bytes, bytes] | None:
+        if b"=" not in tx:
+            return None
+        k, _, v = tx.partition(b"=")
+        if not k:
+            return None
+        return k, v
+
+    def _compute_hash(self, height: int) -> bytes:
+        h = hashlib.sha256()
+        h.update(height.to_bytes(8, "big"))
+        merged = dict(self.store)
+        merged.update(self.pending)
+        for k in sorted(merged):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(merged[k]).to_bytes(4, "big") + merged[k])
+        return h.digest()
+
+    # --- ABCI ---
+    def info(self) -> InfoResponse:
+        return InfoResponse(
+            data="kvstore",
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash if self.height else b"",
+        )
+
+    def init_chain(self, req: InitChainRequest) -> InitChainResponse:
+        return InitChainResponse(validators=[], app_hash=b"")
+
+    def check_tx(self, tx: bytes) -> CheckTxResult:
+        if self._parse(tx) is None:
+            return CheckTxResult(code=1, log="tx must be key=value")
+        return CheckTxResult()
+
+    def process_proposal(self, txs) -> int:
+        for tx in txs:
+            if self._parse(tx) is None:
+                return ProposalStatus.REJECT
+        return ProposalStatus.ACCEPT
+
+    def finalize_block(self, req: FinalizeBlockRequest) -> FinalizeBlockResponse:
+        self.pending = {}
+        self.val_updates = []
+        results = []
+        for tx in req.txs:
+            kv = self._parse(tx)
+            if kv is None:
+                results.append(ExecTxResult(code=1, log="malformed tx"))
+                continue
+            k, v = kv
+            if k.startswith(VALIDATOR_PREFIX):
+                # "val:<hex pubkey>=<power>" mirrors the reference kvstore's
+                # validator-update txs
+                try:
+                    pk = bytes.fromhex(k[len(VALIDATOR_PREFIX):].decode())
+                    power = int(v)
+                    self.val_updates.append(ValidatorUpdate(pk, "ed25519", power))
+                except ValueError:
+                    results.append(ExecTxResult(code=1, log="bad validator tx"))
+                    continue
+            self.pending[k] = v
+            results.append(ExecTxResult(data=v))
+        app_hash = self._compute_hash(req.height)
+        return FinalizeBlockResponse(
+            tx_results=results,
+            validator_updates=list(self.val_updates),
+            app_hash=app_hash,
+        )
+
+    def commit(self) -> int:
+        self.store.update(self.pending)
+        self.pending = {}
+        self.height += 1
+        self.app_hash = self._compute_hash(self.height)
+        return 0
+
+    def query(self, path: str, data: bytes, height: int = 0) -> QueryResponse:
+        v = self.store.get(data)
+        return QueryResponse(
+            code=0 if v is not None else 1,
+            key=data,
+            value=v or b"",
+            height=self.height,
+        )

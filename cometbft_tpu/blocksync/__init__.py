@@ -1,0 +1,3 @@
+"""Block sync: catch-up replay of stored/fetched chains."""
+
+from .replay import ReplayEngine  # noqa: F401

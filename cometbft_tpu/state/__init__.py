@@ -1,0 +1,4 @@
+"""Execution state and the block executor (ABCI driving loop)."""
+
+from .types import State, ConsensusParams  # noqa: F401
+from .execution import BlockExecutor  # noqa: F401

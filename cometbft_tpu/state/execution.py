@@ -1,0 +1,279 @@
+"""BlockExecutor: validate -> FinalizeBlock -> update state -> commit.
+
+Behavior parity with reference internal/state/execution.go:
+- ApplyBlock (:211): validateBlock, ABCI FinalizeBlock (:219), validator
+  update validation (:261), updateState (:586) rotating the three
+  validator sets, app Commit (:379), prune + events.
+- validateBlock (internal/state/validation.go:92) runs
+  state.last_validators.VerifyCommit on every block — the full-signature
+  hot path that rides the TPU batch verifier.
+- CreateProposalBlock (:109) assembles a block through PrepareProposal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ..crypto import merkle
+from ..crypto.ed25519 import Ed25519PubKey
+from ..types import (
+    Block,
+    BlockID,
+    Commit,
+    Data,
+    Header,
+    PartSetHeader,
+    Timestamp,
+    Validator,
+    ValidatorSet,
+    verify_commit,
+)
+from ..types.block import Consensus
+from ..types.validation import CommitError
+from .types import State
+
+
+class BlockValidationError(Exception):
+    pass
+
+
+def median_time(commit: Commit, vals: ValidatorSet) -> Timestamp:
+    """Voting-power-weighted median of commit timestamps
+    (reference types/utils + MedianTime: the canonical block time)."""
+    pairs = []
+    total = 0
+    for idx, cs in enumerate(commit.signatures):
+        if not cs.is_commit():
+            continue
+        val = vals.get_by_index(idx)
+        if val is None:
+            continue
+        pairs.append((cs.timestamp.unix_ns(), val.voting_power))
+        total += val.voting_power
+    if not pairs:
+        return Timestamp()
+    pairs.sort()
+    half = total // 2
+    acc = 0
+    for ts, p in pairs:
+        acc += p
+        if acc > half:
+            return Timestamp.from_unix_ns(ts)
+    return Timestamp.from_unix_ns(pairs[-1][0])
+
+
+def results_hash(tx_results) -> bytes:
+    """last_results_hash input (reference types/results.go Hash)."""
+    return merkle.hash_from_byte_slices([r.encode() for r in tx_results])
+
+
+def validate_block(
+    state: State,
+    block: Block,
+    backend: str = "tpu",
+    last_commit_preverified: bool = False,
+) -> None:
+    """Full block validation against current state
+    (reference internal/state/validation.go).
+
+    last_commit_preverified elides only the signature re-verification of
+    the LastCommit (structure, size, hashes, and median-time checks still
+    run) — used by the batched replay path, which has already verified
+    those exact signatures in a window mega-batch.
+    """
+    h = block.header
+    if h.chain_id != state.chain_id:
+        raise BlockValidationError(f"wrong chain id {h.chain_id}")
+    expected_height = (
+        state.initial_height
+        if state.last_block_height == 0
+        else state.last_block_height + 1
+    )
+    if h.height != expected_height:
+        raise BlockValidationError(
+            f"wrong height {h.height}, expected {expected_height}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise BlockValidationError("wrong last_block_id")
+    if h.validators_hash != state.validators.hash():
+        raise BlockValidationError("wrong validators_hash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise BlockValidationError("wrong next_validators_hash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise BlockValidationError("wrong consensus_hash")
+    if h.app_hash != state.app_hash:
+        raise BlockValidationError("wrong app_hash")
+    if h.last_results_hash != state.last_results_hash:
+        raise BlockValidationError("wrong last_results_hash")
+    if h.data_hash != block.data.hash():
+        raise BlockValidationError("wrong data_hash")
+    if h.last_commit_hash != block.last_commit.hash():
+        raise BlockValidationError("wrong last_commit_hash")
+
+    if h.height == state.initial_height:
+        if block.last_commit.signatures:
+            raise BlockValidationError("initial block must have empty last commit")
+    else:
+        if len(block.last_commit.signatures) != len(state.last_validators):
+            raise BlockValidationError("wrong last commit size")
+        if not last_commit_preverified:
+            try:
+                verify_commit(
+                    state.chain_id,
+                    state.last_validators,
+                    state.last_block_id,
+                    h.height - 1,
+                    block.last_commit,
+                    backend=backend,
+                )
+            except CommitError as e:
+                raise BlockValidationError(f"invalid last commit: {e}") from e
+        # block time must be the weighted median of the last commit
+        expected_time = median_time(block.last_commit, state.last_validators)
+        if h.time != expected_time:
+            raise BlockValidationError("block time != median commit time")
+    if not h.proposer_address or len(h.proposer_address) != 20:
+        raise BlockValidationError("invalid proposer address")
+
+
+class BlockExecutor:
+    def __init__(self, app_conns, state_store=None, block_store=None, backend: str = "tpu"):
+        self.app = app_conns
+        self.state_store = state_store
+        self.block_store = block_store
+        self.backend = backend
+        self.event_handlers: list = []
+
+    # --- proposal side ---
+    def create_proposal_block(
+        self,
+        height: int,
+        state: State,
+        last_commit: Commit,
+        proposer_address: bytes,
+        txs: list[bytes],
+        block_time: Timestamp | None = None,
+    ) -> Block:
+        max_bytes = state.consensus_params.block.max_bytes
+        txs = self.app.consensus.prepare_proposal(txs, max_bytes)
+        if height == state.initial_height:
+            time = block_time or state.last_block_time
+        else:
+            time = median_time(last_commit, state.last_validators)
+        header = Header(
+            version=Consensus(),
+            chain_id=state.chain_id,
+            height=height,
+            time=time,
+            last_block_id=state.last_block_id,
+            last_commit_hash=last_commit.hash(),
+            data_hash=Data(txs).hash(),
+            validators_hash=state.validators.hash(),
+            next_validators_hash=state.next_validators.hash(),
+            consensus_hash=state.consensus_params.hash(),
+            app_hash=state.app_hash,
+            last_results_hash=state.last_results_hash,
+            evidence_hash=merkle.hash_from_byte_slices([]),
+            proposer_address=proposer_address,
+        )
+        return Block(header=header, data=Data(txs), last_commit=last_commit)
+
+    def process_proposal(self, block: Block) -> bool:
+        from ..abci.types import ProposalStatus
+
+        return (
+            self.app.consensus.process_proposal(block.data.txs)
+            == ProposalStatus.ACCEPT
+        )
+
+    # --- commit side ---
+    def apply_block(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        last_commit_preverified: bool = False,
+    ) -> State:
+        from ..abci.types import FinalizeBlockRequest
+
+        validate_block(
+            state,
+            block,
+            backend=self.backend,
+            last_commit_preverified=last_commit_preverified,
+        )
+
+        resp = self.app.consensus.finalize_block(
+            FinalizeBlockRequest(
+                txs=block.data.txs,
+                hash=block.hash() or b"",
+                height=block.header.height,
+                time=block.header.time,
+                next_validators_hash=block.header.next_validators_hash,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        if len(resp.tx_results) != len(block.data.txs):
+            raise BlockValidationError("app returned wrong number of tx results")
+
+        new_state = self._update_state(state, block_id, block, resp)
+
+        self.app.consensus.commit()
+
+        if self.state_store is not None:
+            self.state_store.save(new_state)
+            self.state_store.save_finalize_response(
+                block.header.height, results_hash(resp.tx_results)
+            )
+        for handler in self.event_handlers:
+            handler(block, resp)
+        return new_state
+
+    def apply_block_preverified(self, state: State, block_id: BlockID, block: Block) -> State:
+        """apply_block with LastCommit signatures already verified by the
+        replay window mega-batch (all structural checks still run)."""
+        return self.apply_block(state, block_id, block, last_commit_preverified=True)
+
+    def _update_state(self, state: State, block_id: BlockID, block: Block, resp) -> State:
+        n_vals = state.next_validators.copy()
+        changed = state.last_height_validators_changed
+        if resp.validator_updates:
+            changes = []
+            for vu in resp.validator_updates:
+                pk = Ed25519PubKey(vu.pub_key_bytes)
+                changes.append(Validator.from_pub_key(pk, vu.power))
+            n_vals.update_with_change_set(changes)
+            changed = block.header.height + 2
+        n_vals.increment_proposer_priority(1)
+        return replace(
+            state,
+            last_block_height=block.header.height,
+            last_block_id=block_id,
+            last_block_time=block.header.time,
+            last_validators=state.validators.copy(),
+            validators=state.next_validators.copy(),
+            next_validators=n_vals,
+            last_height_validators_changed=changed,
+            last_results_hash=results_hash(resp.tx_results),
+            app_hash=resp.app_hash,
+        )
+
+
+def make_genesis_state(
+    chain_id: str,
+    validators: ValidatorSet,
+    app_hash: bytes = b"",
+    initial_height: int = 1,
+    genesis_time: Timestamp | None = None,
+) -> State:
+    """Genesis -> State (reference internal/state/state.go MakeGenesisState)."""
+    return State(
+        chain_id=chain_id,
+        initial_height=initial_height,
+        last_block_height=0,
+        last_block_time=genesis_time or Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+        validators=validators.copy(),
+        last_validators=None,  # empty at genesis (reference MakeGenesisState)
+        next_validators=validators.copy_increment_proposer_priority(1),
+        last_height_validators_changed=initial_height,
+    )

@@ -1,0 +1,165 @@
+"""The `State` value object (reference internal/state/state.go:352).
+
+Everything needed to validate and execute the next block: rotated
+validator sets (last/current/next), consensus params, app hash, last
+results hash. Immutable-ish: every ApplyBlock produces a new State.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..crypto.ed25519 import Ed25519PubKey
+from ..encoding import proto as pb
+from ..types import BlockID, Timestamp, Validator, ValidatorSet, ZERO_TIME
+from ..types.basic import ZERO_BLOCK_ID
+from ..types.validator_set import encode_pub_key
+
+
+@dataclass(frozen=True)
+class BlockParams:
+    max_bytes: int = 4 * 1024 * 1024  # reference types/params.go defaults
+    max_gas: int = -1
+
+
+@dataclass(frozen=True)
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * 3600 * 1_000_000_000
+    max_bytes: int = 1024 * 1024
+
+
+@dataclass(frozen=True)
+class ValidatorParams:
+    pub_key_types: tuple[str, ...] = ("ed25519",)
+
+
+@dataclass(frozen=True)
+class ABCIParams:
+    vote_extensions_enable_height: int = 0
+
+
+@dataclass(frozen=True)
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    abci: ABCIParams = field(default_factory=ABCIParams)
+
+    def hash(self) -> bytes:
+        """Hash over the consensus-critical params (reference
+        types/params.go HashConsensusParams: SHA-256 of proto of
+        block.max_bytes/max_gas)."""
+        from ..crypto.keys import tmhash
+
+        payload = pb.f_varint(1, self.block.max_bytes) + pb.f_varint(
+            2, self.block.max_gas
+        )
+        return tmhash(payload)
+
+
+def _encode_validator(v: Validator) -> bytes:
+    return (
+        pb.f_bytes(1, v.address)
+        + pb.f_embedded(2, encode_pub_key(v.pub_key))
+        + pb.f_varint(3, v.voting_power)
+        + pb.f_varint(4, v.proposer_priority)
+    )
+
+
+def _decode_validator(buf: bytes) -> Validator:
+    d = pb.fields_to_dict(buf)
+    key_fields = pb.fields_to_dict(bytes(d.get(2, b"")))
+    if 1 in key_fields:
+        pk = Ed25519PubKey(bytes(key_fields[1]))
+    else:
+        raise ValueError("unsupported pubkey type in storage")
+    return Validator(
+        address=bytes(d.get(1, b"")),
+        pub_key=pk,
+        voting_power=pb.to_i64(d.get(3, 0)),
+        proposer_priority=pb.to_i64(d.get(4, 0)),
+    )
+
+
+def encode_validator_set(vs: ValidatorSet) -> bytes:
+    out = b""
+    for v in vs.validators:
+        out += pb.f_embedded(1, _encode_validator(v))
+    prop = vs.get_proposer()
+    out += pb.f_bytes(2, prop.address)
+    return out
+
+
+def decode_validator_set(buf: bytes) -> ValidatorSet:
+    vals = []
+    prop_addr = b""
+    for f, _, v in pb.parse_fields(buf):
+        if f == 1:
+            vals.append(_decode_validator(bytes(v)))
+        elif f == 2:
+            prop_addr = bytes(v)
+    vs = ValidatorSet(vals, increment_first=False)
+    # restore exact priorities (ValidatorSet() copies, order by power)
+    if prop_addr:
+        _, p = vs.get_by_address(prop_addr)
+        vs.proposer = p
+    return vs
+
+
+@dataclass
+class State:
+    chain_id: str = ""
+    initial_height: int = 1
+    last_block_height: int = 0
+    last_block_id: BlockID = ZERO_BLOCK_ID
+    last_block_time: Timestamp = ZERO_TIME
+    validators: ValidatorSet | None = None
+    last_validators: ValidatorSet | None = None
+    next_validators: ValidatorSet | None = None
+    last_height_validators_changed: int = 1
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_params_changed: int = 1
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return replace(self)
+
+    def encode(self) -> bytes:
+        out = (
+            pb.f_string(1, self.chain_id)
+            + pb.f_varint(2, self.initial_height)
+            + pb.f_varint(3, self.last_block_height)
+            + pb.f_embedded(4, self.last_block_id.encode())
+            + pb.f_embedded(5, self.last_block_time.encode())
+            + pb.f_varint(8, self.last_height_validators_changed)
+            + pb.f_bytes(10, self.last_results_hash)
+            + pb.f_bytes(11, self.app_hash)
+            + pb.f_varint(12, self.last_height_params_changed)
+        )
+        if self.validators is not None:
+            out += pb.f_embedded(6, encode_validator_set(self.validators))
+        if self.last_validators is not None:
+            out += pb.f_embedded(7, encode_validator_set(self.last_validators))
+        if self.next_validators is not None:
+            out += pb.f_embedded(9, encode_validator_set(self.next_validators))
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "State":
+        d = pb.fields_to_dict(buf)
+        return cls(
+            chain_id=bytes(d.get(1, b"")).decode(),
+            initial_height=pb.to_i64(d.get(2, 1)),
+            last_block_height=pb.to_i64(d.get(3, 0)),
+            last_block_id=BlockID.decode(bytes(d.get(4, b""))),
+            last_block_time=Timestamp.decode(bytes(d.get(5, b""))),
+            validators=decode_validator_set(bytes(d[6])) if 6 in d else None,
+            last_validators=decode_validator_set(bytes(d[7])) if 7 in d else None,
+            next_validators=decode_validator_set(bytes(d[9])) if 9 in d else None,
+            last_height_validators_changed=pb.to_i64(d.get(8, 1)),
+            last_results_hash=bytes(d.get(10, b"")),
+            app_hash=bytes(d.get(11, b"")),
+            last_height_params_changed=pb.to_i64(d.get(12, 1)),
+        )

@@ -1,0 +1,10 @@
+"""Durable stores: KV abstraction, block store, state store.
+
+Mirrors the reference's storage split (internal/store BlockStore over a
+cometbft-db KV backend, internal/state state store) with Python-native
+backends: in-memory dict and SQLite (single-file, transactional).
+"""
+
+from .kv import KVStore, MemKV, SqliteKV, open_kv  # noqa: F401
+from .blockstore import BlockStore  # noqa: F401
+from .statestore import StateStore  # noqa: F401

@@ -1,0 +1,111 @@
+"""Block store: heights -> blocks, commits, seen-commits.
+
+Behavior parity with reference internal/store/store.go:42 (BlockStore):
+SaveBlock persists the block, its commit (the canonical +2/3 for
+height-1... stored per height), and the "seen commit" used to propose the
+next block; base/height track the retained range; Prune deletes below a
+retain height (reference :309).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..encoding import proto as pb
+from ..types import Block, Commit
+from .kv import KVStore
+
+
+def _key_block(h: int) -> bytes:
+    return b"B:" + h.to_bytes(8, "big")
+
+
+def _key_commit(h: int) -> bytes:
+    return b"C:" + h.to_bytes(8, "big")
+
+
+def _key_seen_commit(h: int) -> bytes:
+    return b"SC:" + h.to_bytes(8, "big")
+
+
+_KEY_STATE = b"BS:state"
+
+
+class BlockStore:
+    def __init__(self, db: KVStore):
+        self._db = db
+        self._lock = threading.RLock()
+        self._base = 0
+        self._height = 0
+        raw = db.get(_KEY_STATE)
+        if raw:
+            d = pb.fields_to_dict(raw)
+            self._base = pb.to_i64(d.get(1, 0))
+            self._height = pb.to_i64(d.get(2, 0))
+
+    def base(self) -> int:
+        with self._lock:
+            return self._base
+
+    def height(self) -> int:
+        with self._lock:
+            return self._height
+
+    def size(self) -> int:
+        with self._lock:
+            return 0 if self._height == 0 else self._height - self._base + 1
+
+    def _save_meta(self, sets):
+        payload = pb.f_varint(1, self._base) + pb.f_varint(2, self._height)
+        sets.append((_KEY_STATE, payload))
+
+    def save_block(self, block: Block, seen_commit: Commit) -> None:
+        h = block.header.height
+        with self._lock:
+            if self._height and h != self._height + 1:
+                raise ValueError(
+                    f"non-contiguous save: have {self._height}, got {h}"
+                )
+            sets = [
+                (_key_block(h), block.encode()),
+                (_key_seen_commit(h), seen_commit.encode()),
+            ]
+            if block.last_commit is not None and h > 1:
+                sets.append((_key_commit(h - 1), block.last_commit.encode()))
+            self._height = h
+            if self._base == 0:
+                self._base = h
+            self._save_meta(sets)
+            self._db.write_batch(sets)
+
+    def load_block(self, height: int) -> Block | None:
+        raw = self._db.get(_key_block(height))
+        return Block.decode(raw) if raw else None
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit FOR `height` (stored with block height+1)."""
+        raw = self._db.get(_key_commit(height))
+        return Commit.decode(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(_key_seen_commit(height))
+        return Commit.decode(raw) if raw else None
+
+    def prune(self, retain_height: int) -> int:
+        """Delete blocks below retain_height; returns number pruned
+        (reference internal/store/store.go:309)."""
+        with self._lock:
+            if retain_height <= self._base:
+                return 0
+            if retain_height > self._height + 1:
+                raise ValueError("cannot prune beyond store height + 1")
+            deletes = []
+            pruned = 0
+            for h in range(self._base, retain_height):
+                deletes += [_key_block(h), _key_commit(h), _key_seen_commit(h)]
+                pruned += 1
+            self._base = retain_height
+            sets: list = []
+            self._save_meta(sets)
+            self._db.write_batch(sets, deletes)
+            return pruned

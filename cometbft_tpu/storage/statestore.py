@@ -1,0 +1,83 @@
+"""State store: current state + per-height validator sets and ABCI results.
+
+Behavior parity with reference internal/state/store.go:132: validators are
+saved per height so light/evidence verification can look back; finalize
+responses are saved for last_results_hash and reindexing; pruning removes
+old heights (reference :297).
+"""
+
+from __future__ import annotations
+
+from ..encoding import proto as pb
+from .kv import KVStore
+
+_KEY_STATE = b"S:cur"
+
+
+def _key_vals(h: int) -> bytes:
+    return b"SV:" + h.to_bytes(8, "big")
+
+
+def _key_abci(h: int) -> bytes:
+    return b"SA:" + h.to_bytes(8, "big")
+
+
+def _key_params(h: int) -> bytes:
+    return b"SP:" + h.to_bytes(8, "big")
+
+
+class StateStore:
+    def __init__(self, db: KVStore):
+        self._db = db
+
+    def save(self, state) -> None:
+        from ..state.types import encode_validator_set
+
+        sets = [(_KEY_STATE, state.encode())]
+        if state.next_validators is not None:
+            # validators for height H were saved when H-1 committed; on each
+            # save we record next_validators at last_height+2 like the
+            # reference's bootstrap/save split
+            sets.append(
+                (
+                    _key_vals(state.last_block_height + 2),
+                    encode_validator_set(state.next_validators),
+                )
+            )
+        if state.validators is not None:
+            sets.append(
+                (
+                    _key_vals(state.last_block_height + 1),
+                    encode_validator_set(state.validators),
+                )
+            )
+        self._db.write_batch(sets)
+
+    def load(self):
+        from ..state.types import State
+
+        raw = self._db.get(_KEY_STATE)
+        return State.decode(raw) if raw else None
+
+    def load_validators(self, height: int):
+        from ..state.types import decode_validator_set
+
+        raw = self._db.get(_key_vals(height))
+        return decode_validator_set(raw) if raw else None
+
+    def save_finalize_response(self, height: int, payload: bytes) -> None:
+        self._db.set(_key_abci(height), payload)
+
+    def load_finalize_response(self, height: int) -> bytes | None:
+        return self._db.get(_key_abci(height))
+
+    def prune(self, retain_height: int, current_height: int) -> int:
+        deletes = []
+        pruned = 0
+        for h in range(1, retain_height):
+            if self._db.has(_key_vals(h)) or self._db.has(_key_abci(h)):
+                deletes += [_key_vals(h), _key_abci(h), _key_params(h)]
+                pruned += 1
+        if deletes:
+            self._db.write_batch([], deletes)
+        return pruned

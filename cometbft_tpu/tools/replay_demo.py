@@ -1,0 +1,50 @@
+"""Replay demo: generate a signed chain, then replay it end-to-end.
+
+Usage: python -m cometbft_tpu.tools.replay_demo [blocks] [validators] [mode]
+
+Generates `blocks` heights signed by `validators` validators (device-batched
+signing), stores them, then replays through ABCI with commit verification
+(mode = batched|full) and prints throughput.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main(argv: list[str]) -> int:
+    n_blocks = int(argv[1]) if len(argv) > 1 else 20
+    n_vals = int(argv[2]) if len(argv) > 2 else 16
+    mode = argv[3] if len(argv) > 3 else "batched"
+
+    from ..abci.client import AppConns
+    from ..abci.kvstore import KVStoreApp
+    from ..blocksync import ReplayEngine
+    from ..state.execution import BlockExecutor
+    from ..utils import factories as fx
+
+    t0 = time.perf_counter()
+    store, final_state, genesis, _ = fx.make_chain(
+        n_blocks=n_blocks, n_validators=n_vals, backend="cpu"
+    )
+    gen_s = time.perf_counter() - t0
+    print(
+        f"generated chain: {n_blocks} blocks x {n_vals} validators "
+        f"in {gen_s:.1f}s (app_hash {final_state.app_hash.hex()[:16]}…)"
+    )
+
+    executor = BlockExecutor(AppConns(KVStoreApp()))
+    engine = ReplayEngine(store, executor, verify_mode=mode)
+    state, stats = engine.run(genesis.copy())
+    ok = state.app_hash == final_state.app_hash
+    print(
+        f"replayed {stats.blocks} blocks ({stats.sigs_verified} sigs, mode={mode}) "
+        f"in {stats.elapsed_s:.2f}s -> {stats.blocks_per_sec:.1f} blocks/s; "
+        f"state match: {ok}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

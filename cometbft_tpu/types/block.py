@@ -245,6 +245,15 @@ def tx_hash(tx: bytes) -> bytes:
     return tmhash(tx)
 
 
+def block_id_for(block: "Block") -> BlockID:
+    """Canonical BlockID: header hash + part-set header over the block bytes
+    (reference types/block.go MakePartSet + BlockID)."""
+    from .part_set import PartSet
+
+    ps = PartSet.from_data(block.encode())
+    return BlockID(block.hash(), ps.header)
+
+
 @dataclass
 class Data:
     txs: list[bytes] = field(default_factory=list)

@@ -116,6 +116,60 @@ def make_block_id(tag: bytes = b"block") -> BlockID:
     return BlockID(h, PartSetHeader(1, hashlib.sha256(tag + b"parts").digest()))
 
 
+from ..types.block import block_id_for  # re-export for existing callers
+
+
+def make_chain(
+    n_blocks: int,
+    n_validators: int = 4,
+    chain_id: str = "replay-chain",
+    txs_per_block: int = 2,
+    app=None,
+    block_store=None,
+    seed: int = 0,
+    backend: str = "cpu",
+):
+    """Generate a fully-valid signed chain by actually running the executor.
+
+    Returns (block_store, final_state, genesis_state, signers). Every block
+    is built with create_proposal_block, committed by all validators
+    (device-batched signing), and applied through ABCI — so replaying the
+    store reproduces byte-identical state.
+    """
+    from ..abci.client import AppConns
+    from ..abci.kvstore import KVStoreApp
+    from ..state.execution import BlockExecutor, make_genesis_state
+    from ..storage import BlockStore, MemKV
+
+    signers = make_signers(n_validators, seed=seed)
+    vals = make_validator_set(signers)
+    by_addr = {s.address(): s for s in signers}
+    app = app or KVStoreApp()
+    store = block_store or BlockStore(MemKV())
+    executor = BlockExecutor(AppConns(app), backend=backend)
+    genesis = make_genesis_state(chain_id, vals)
+    state = genesis.copy()
+
+    last_commit = Commit()
+    for h in range(1, n_blocks + 1):
+        txs = [b"k%d-%d=v%d" % (h, i, i) for i in range(txs_per_block)]
+        proposer = state.validators.get_proposer()
+        block = executor.create_proposal_block(
+            h, state, last_commit, proposer.address, txs,
+            block_time=state.last_block_time,
+        )
+        bid = block_id_for(block)
+        vals_h = state.validators  # the set that signs height h's commit
+        state = executor.apply_block(state, bid, block)
+        commit = make_commit(
+            chain_id, h, 0, bid, vals_h, by_addr,
+            time_ns=state.last_block_time.unix_ns() + 1_000_000_000,
+        )
+        store.save_block(block, commit)
+        last_commit = commit
+    return store, state, genesis, signers
+
+
 def make_commit(
     chain_id: str,
     height: int,
