@@ -22,6 +22,7 @@ from ..crypto import ed25519
 from ..state.execution import BlockExecutor, BlockValidationError, validate_block
 from ..storage import BlockStore
 from ..types import Commit
+from ..types.block import block_id_for
 from ..types.validation import (
     CommitError,
     ErrInvalidSignature,
@@ -74,49 +75,99 @@ class ReplayEngine:
             c = self.store.load_seen_commit(height)
         return c
 
-    def _light_check_window(self, state, heights: list[int]) -> int:
-        """Batch VerifyCommitLight across many heights in one device call.
+    def _light_check_window(self, state, blocks: list) -> int:
+        """Batch every signature check the per-block path would do across a
+        window of blocks, in one device call.
+
+        Two families of commits go into the mega-batch:
+
+        1. Each block's EMBEDDED LastCommit, with full VerifyCommit
+           semantics (reference types/validation.go:21-34: every non-absent
+           signature — COMMIT and NIL votes alike — verified; COMMIT votes
+           tallied to +2/3; commit bound to the predecessor's computed
+           BlockID). This is exactly the check apply_block_preverified
+           elides, so eliding it is sound.
+        2. The STORED commit for the window's last block, VerifyCommitLight
+           semantics (reference internal/blocksync/reactor.go:462: the tip
+           needs an external +2/3 endorsement since no successor block in
+           this window embeds one).
 
         Returns number of signatures verified. Raises CommitError on any
-        invalid signature or insufficient tally.
+        invalid signature, block-id mismatch, or insufficient tally.
         """
         bv = ed25519.Ed25519BatchVerifier(backend=self.backend)
-        # (height, tally_target, [(power, lane_index)]) bookkeeping.
-        # The window only spans heights with an unchanged validator set
-        # (caller checks validators_hash), so one set serves all lanes.
-        per_height: list[tuple[int, int, list[tuple[int, int]]]] = []
-        vals = state.validators
+        per_commit: list[tuple[int, int, list[tuple[int, int]]]] = []
         lane = 0
-        for h in heights:
-            block = self.store.load_block(h)
-            commit = self._commit_for(h)
-            if block is None or commit is None:
-                raise BlockValidationError(f"missing block/commit at height {h}")
-            if commit.height != h:
-                raise CommitError(f"commit height mismatch at {h}")
+
+        def queue_commit(commit, vals, expect_bid, height, all_sigs):
+            nonlocal lane
+            if commit.height != height:
+                raise CommitError(
+                    f"commit height {commit.height}, expected {height}"
+                )
+            if commit.block_id != expect_bid:
+                raise CommitError(f"commit at height {height} is for a different block")
+            if commit.size() != len(vals):
+                raise CommitError(
+                    f"commit size {commit.size()} != validator set {len(vals)}"
+                )
             entries = []
             for idx, cs in enumerate(commit.signatures):
-                if not cs.is_commit():
+                if cs.is_absent() or (not all_sigs and not cs.is_commit()):
                     continue
                 val = vals.get_by_index(idx)
                 if val is None or val.address != cs.validator_address:
                     raise ErrInvalidSignature(
-                        f"address mismatch at height {h} index {idx}"
+                        f"address mismatch at height {height} index {idx}"
                     )
-                bv.add(
-                    val.pub_key,
-                    commit.vote_sign_bytes(state.chain_id, idx),
-                    cs.signature,
-                )
-                entries.append((val.voting_power, lane))
+                msg = commit.vote_sign_bytes(state.chain_id, idx)
+                before = bv.count()
+                bv.add(val.pub_key, msg, cs.signature)
+                if bv.count() == before:
+                    # batch verifier refused the key type (no lane was
+                    # consumed): verify singly, like _verify_items' fallback
+                    if not val.pub_key.verify_signature(msg, cs.signature):
+                        raise ErrInvalidSignature(
+                            f"invalid signature at height {height} index {idx}"
+                        )
+                    if cs.is_commit():
+                        entries.append((val.voting_power, -1))
+                    continue
+                if cs.is_commit():
+                    entries.append((val.voting_power, lane))
                 lane += 1
-            per_height.append((h, vals.total_voting_power() * 2 // 3, entries))
+            per_commit.append(
+                (height, vals.total_voting_power() * 2 // 3, entries)
+            )
+
+        # The window only spans heights whose header.validators_hash equals
+        # state.validators.hash() (caller enforces), so every embedded
+        # LastCommit except the first block's was signed by state.validators;
+        # the first block's was signed by state.last_validators.
+        prev_bid = state.last_block_id
+        lc_vals = state.last_validators
+        for blk in blocks:
+            h = blk.header.height
+            if h != state.initial_height:
+                if lc_vals is None:
+                    raise BlockValidationError(
+                        f"no validator set for last commit of height {h}"
+                    )
+                queue_commit(blk.last_commit, lc_vals, prev_bid, h - 1, all_sigs=True)
+            prev_bid = block_id_for(blk)
+            lc_vals = state.validators
+        tip = blocks[-1].header.height
+        commit = self._commit_for(tip)
+        if commit is None:
+            raise BlockValidationError(f"missing commit at height {tip}")
+        queue_commit(commit, state.validators, prev_bid, tip, all_sigs=False)
+
         ok, bits = bv.verify()
         if not ok:
             for i, b in enumerate(bits):
                 if not b:
                     raise ErrInvalidSignature(f"invalid signature in window lane {i}")
-        for h, threshold, entries in per_height:
+        for h, threshold, entries in per_commit:
             tally = sum(p for p, _ in entries)
             if tally <= threshold:
                 raise ErrNotEnoughVotingPower(
@@ -136,30 +187,26 @@ class ReplayEngine:
                 # comparing the stored blocks' validators_hash
                 w_end = min(h + self.window - 1, tip)
                 cur_hash = state.validators.hash()
-                heights = []
+                blocks = []
                 for hh in range(h, w_end + 1):
                     blk = self.store.load_block(hh)
                     if blk is None or blk.header.validators_hash != cur_hash:
                         break
-                    heights.append(hh)
-                if not heights:
+                    blocks.append(blk)
+                if not blocks:
                     raise BlockValidationError(f"cannot form window at height {h}")
-                stats.sigs_verified += self._light_check_window(state, heights)
-                for hh in heights:
-                    block = self.store.load_block(hh)
-                    from ..utils.factories import block_id_for
-
+                stats.sigs_verified += self._light_check_window(state, blocks)
+                for block in blocks:
                     bid = block_id_for(block)
                     state = self.executor.apply_block_preverified(state, bid, block)
                     stats.blocks += 1
-                h = heights[-1] + 1
+                h = blocks[-1].header.height + 1
             else:
                 block = self.store.load_block(h)
                 commit = self._commit_for(h)
                 if block is None or commit is None:
                     raise BlockValidationError(f"missing block/commit at {h}")
                 from ..types.validation import verify_commit_light
-                from ..utils.factories import block_id_for
 
                 bid = block_id_for(block)
                 verify_commit_light(

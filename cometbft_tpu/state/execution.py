@@ -38,14 +38,18 @@ class BlockValidationError(Exception):
 
 
 def median_time(commit: Commit, vals: ValidatorSet) -> Timestamp:
-    """Voting-power-weighted median of commit timestamps
-    (reference types/utils + MedianTime: the canonical block time)."""
+    """Voting-power-weighted median of commit timestamps (reference
+    internal/state/state.go:266 MedianTime + types/time/time.go:35
+    WeightedMedian): every non-ABSENT signature's timestamp counts
+    (including NIL votes), validators are looked up by address, and the
+    pick is the first sorted timestamp whose cumulative weight reaches
+    total/2 (ties take the earlier timestamp)."""
     pairs = []
     total = 0
-    for idx, cs in enumerate(commit.signatures):
-        if not cs.is_commit():
+    for cs in commit.signatures:
+        if cs.is_absent():
             continue
-        val = vals.get_by_index(idx)
+        _, val = vals.get_by_address(cs.validator_address)
         if val is None:
             continue
         pairs.append((cs.timestamp.unix_ns(), val.voting_power))
@@ -53,12 +57,11 @@ def median_time(commit: Commit, vals: ValidatorSet) -> Timestamp:
     if not pairs:
         return Timestamp()
     pairs.sort()
-    half = total // 2
-    acc = 0
+    median = total // 2
     for ts, p in pairs:
-        acc += p
-        if acc > half:
+        if median <= p:
             return Timestamp.from_unix_ns(ts)
+        median -= p
     return Timestamp.from_unix_ns(pairs[-1][0])
 
 

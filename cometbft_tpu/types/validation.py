@@ -63,15 +63,18 @@ def _verify_items(items, backend: str):
         if addable:
             ok, bits = bv.verify()
         if not ok:
-            if bits is not None:
+            if bits:
+                # device bitmap pinpoints failures directly — no rescan
                 for i, b in enumerate(bits):
                     if not b:
                         raise ErrInvalidSignature(f"invalid signature at index {i}")
-            # fall back to singles to locate the failure
-            for i, (pub, msg, sig, _) in enumerate(items):
-                if not pub.verify_signature(msg, sig):
-                    raise ErrInvalidSignature(f"invalid signature at index {i}")
-            raise ErrInvalidSignature("batch verification failed")
+            else:
+                # batch could not run (e.g. unsupported key type): fall back
+                # to single verification like the reference (:327). If every
+                # signature passes singly, the commit is valid — accept.
+                for i, (pub, msg, sig, _) in enumerate(items):
+                    if not pub.verify_signature(msg, sig):
+                        raise ErrInvalidSignature(f"invalid signature at index {i}")
     else:
         for i, (pub, msg, sig, _) in enumerate(items):
             if not pub.verify_signature(msg, sig):

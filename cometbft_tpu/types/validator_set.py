@@ -95,6 +95,7 @@ class ValidatorSet:
         self.validators: list[Validator] = vals
         self.proposer: Validator | None = None
         self._total_power: int | None = None
+        self._addr_index: dict[bytes, int] | None = None
         self.total_voting_power()  # validates the cap
         if increment_first:
             self.increment_proposer_priority(1)
@@ -115,10 +116,14 @@ class ValidatorSet:
         return self._total_power
 
     def get_by_address(self, addr: bytes) -> tuple[int, Validator | None]:
-        for i, v in enumerate(self.validators):
-            if v.address == addr:
-                return i, v
-        return -1, None
+        # O(1) address index (10k-validator light-trusting verification
+        # does one lookup per signature; a linear scan would be O(N^2)).
+        if self._addr_index is None:
+            self._addr_index = {
+                v.address: i for i, v in enumerate(self.validators)
+            }
+        i = self._addr_index.get(addr, -1)
+        return (i, self.validators[i]) if i >= 0 else (-1, None)
 
     def get_by_index(self, idx: int) -> Validator | None:
         if 0 <= idx < len(self.validators):
@@ -138,6 +143,7 @@ class ValidatorSet:
         vs.validators = [v.copy() for v in self.validators]
         vs.proposer = self.proposer.copy() if self.proposer else None
         vs._total_power = self._total_power
+        vs._addr_index = None
         return vs
 
     # --- proposer priority machinery ---
@@ -206,11 +212,13 @@ class ValidatorSet:
     # --- updates (ABCI validator changes) ---
 
     def update_with_change_set(self, changes: list[Validator]):
-        """Apply power updates / removals (power 0), reference :659.
+        """Apply power updates / removals (power 0), reference :594-643.
 
-        New validators enter with priority -(P' + P'/8) where P' is the
-        total power after the update; priorities are then recentered and
-        rescaled into the window.
+        New validators enter with priority -(P' + P'>>3) where P' is
+        tvpAfterUpdatesBeforeRemovals — the total power with all updates
+        applied but removals NOT yet applied (reference verifyUpdates
+        :423-455, computeNewPriorities :479); priorities are then rescaled
+        into the window and recentered, in that order (:638-639).
         """
         if not changes:
             return
@@ -226,6 +234,20 @@ class ValidatorSet:
         for a in removals:
             if not self.has_address(a):
                 raise ValueError("removing non-existent validator")
+
+        # tvp after updates, before removals (reference verifyUpdates):
+        # old total plus the delta of every non-removal change.
+        tvp_updates = self.total_voting_power()
+        for a, c in by_addr.items():
+            if c.voting_power == 0:
+                continue
+            _, old = self.get_by_address(a)
+            tvp_updates += c.voting_power - (old.voting_power if old else 0)
+        removed_power = sum(
+            self.get_by_address(a)[1].voting_power for a in removals
+        )
+        if tvp_updates - removed_power > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("total voting power exceeds cap after update")
 
         kept = [v for v in self.validators if v.address not in removals]
         updated = []
@@ -248,22 +270,19 @@ class ValidatorSet:
 
         if not updated:
             raise ValueError("applying changes would empty the validator set")
-        total = 0
-        for v in updated:
-            total += v.voting_power
-            if total > MAX_TOTAL_VOTING_POWER:
-                raise ValueError("total voting power exceeds cap after update")
 
-        penalty = -_clip(total + total // 8)
+        penalty = -(tvp_updates + (tvp_updates >> 3))
+        new_set = set(new_addrs)
         for v in updated:
-            if v.address in set(new_addrs):
+            if v.address in new_set:
                 v.proposer_priority = penalty
 
         self.validators = sorted(updated, key=_sort_key)
         self._total_power = None
+        self._addr_index = None
         self.total_voting_power()
-        # recenter + rescale into the priority window
-        self._shift_by_avg()
+        # scale into the priority window, then center (reference order)
         self.rescale_priorities(
             PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
         )
+        self._shift_by_avg()

@@ -128,6 +128,8 @@ def make_chain(
     block_store=None,
     seed: int = 0,
     backend: str = "cpu",
+    nil_votes: dict[int, set[int]] | None = None,
+    corrupt_sig: tuple[int, int] | None = None,
 ):
     """Generate a fully-valid signed chain by actually running the executor.
 
@@ -135,6 +137,13 @@ def make_chain(
     is built with create_proposal_block, committed by all validators
     (device-batched signing), and applied through ABCI — so replaying the
     store reproduces byte-identical state.
+
+    nil_votes maps height -> validator indices casting NIL precommits in
+    that height's commit. corrupt_sig=(height, idx) flips a byte of that
+    commit signature after signing (the corrupted commit still propagates
+    into the next block's embedded LastCommit, so verification during
+    generation is elided for such chains — they exist to test that replay
+    REJECTS them).
     """
     from ..abci.client import AppConns
     from ..abci.kvstore import KVStoreApp
@@ -160,11 +169,20 @@ def make_chain(
         )
         bid = block_id_for(block)
         vals_h = state.validators  # the set that signs height h's commit
-        state = executor.apply_block(state, bid, block)
+        state = executor.apply_block(
+            state, bid, block,
+            last_commit_preverified=corrupt_sig is not None,
+        )
         commit = make_commit(
             chain_id, h, 0, bid, vals_h, by_addr,
             time_ns=state.last_block_time.unix_ns() + 1_000_000_000,
+            nil=(nil_votes or {}).get(h),
         )
+        if corrupt_sig is not None and corrupt_sig[0] == h:
+            cs = commit.signatures[corrupt_sig[1]]
+            sig = bytearray(cs.signature)
+            sig[0] ^= 0xFF
+            cs.signature = bytes(sig)
         store.save_block(block, commit)
         last_commit = commit
     return store, state, genesis, signers
@@ -179,11 +197,13 @@ def make_commit(
     signers_by_addr: dict[bytes, ScalarSigner],
     time_ns: int = 1_700_000_000_000_000_000,
     absent: set[int] | None = None,
+    nil: set[int] | None = None,
     sign_seed: int | None = None,
 ) -> Commit:
-    """A commit signed by every validator (minus `absent` indices), ordered
-    to match the validator set."""
+    """A commit signed by every validator (minus `absent` indices; `nil`
+    indices sign a NIL precommit), ordered to match the validator set."""
     absent = absent or set()
+    nil = nil or set()
     commit = Commit(height=height, round=round_, block_id=block_id, signatures=[])
     sig_slots = []
     signers, msgs = [], []
@@ -194,7 +214,7 @@ def make_commit(
             continue
         ts = Timestamp.from_unix_ns(time_ns + idx)
         cs = CommitSig(
-            block_id_flag=BlockIDFlag.COMMIT,
+            block_id_flag=BlockIDFlag.NIL if idx in nil else BlockIDFlag.COMMIT,
             validator_address=val.address,
             timestamp=ts,
             signature=b"",

@@ -49,7 +49,11 @@ def test_replay_batched_mode_matches_full(chain):
     engine = ReplayEngine(store, executor, verify_mode="batched", window=3, backend="cpu")
     state, stats = engine.run(genesis.copy())
     assert stats.blocks == 8
-    assert stats.sigs_verified == 8 * 4  # every commit sig light-checked
+    # Per window: every embedded LastCommit (full VerifyCommit semantics)
+    # plus the stored tip commit. Windows of 3 over 8 blocks: [1-3] LC2,LC3
+    # + tip3 = 12 sigs; [4-6] LC4..LC6 + tip6 = 16; [7-8] LC7,LC8 + tip8
+    # = 12 -> 40 with 4 validators.
+    assert stats.sigs_verified == 40
     assert state.app_hash == final_state.app_hash
 
 
