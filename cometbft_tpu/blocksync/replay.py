@@ -95,20 +95,18 @@ class ReplayEngine:
         Returns number of signatures verified. Raises CommitError on any
         invalid signature, block-id mismatch, or insufficient tally.
         """
+        from ..types.validation import _check_commit_basics, ErrInvalidCommitSize
+
         bv = ed25519.Ed25519BatchVerifier(backend=self.backend)
-        per_commit: list[tuple[int, int, list[tuple[int, int]]]] = []
+        per_commit: list[tuple[int, int, list[int]]] = []
         lane = 0
+        singles = 0
 
         def queue_commit(commit, vals, expect_bid, height, all_sigs):
-            nonlocal lane
-            if commit.height != height:
-                raise CommitError(
-                    f"commit height {commit.height}, expected {height}"
-                )
-            if commit.block_id != expect_bid:
-                raise CommitError(f"commit at height {height} is for a different block")
+            nonlocal lane, singles
+            _check_commit_basics(vals, commit, height, expect_bid)
             if commit.size() != len(vals):
-                raise CommitError(
+                raise ErrInvalidCommitSize(
                     f"commit size {commit.size()} != validator set {len(vals)}"
                 )
             entries = []
@@ -130,12 +128,11 @@ class ReplayEngine:
                         raise ErrInvalidSignature(
                             f"invalid signature at height {height} index {idx}"
                         )
-                    if cs.is_commit():
-                        entries.append((val.voting_power, -1))
-                    continue
+                    singles += 1
+                else:
+                    lane += 1
                 if cs.is_commit():
-                    entries.append((val.voting_power, lane))
-                lane += 1
+                    entries.append(val.voting_power)
             per_commit.append(
                 (height, vals.total_voting_power() * 2 // 3, entries)
             )
@@ -168,12 +165,12 @@ class ReplayEngine:
                 if not b:
                     raise ErrInvalidSignature(f"invalid signature in window lane {i}")
         for h, threshold, entries in per_commit:
-            tally = sum(p for p, _ in entries)
+            tally = sum(entries)
             if tally <= threshold:
                 raise ErrNotEnoughVotingPower(
                     f"height {h}: tallied {tally} <= {threshold}"
                 )
-        return lane
+        return lane + singles
 
     def run(self, state, to_height: int | None = None) -> tuple[object, ReplayStats]:
         """Replay from state.last_block_height+1 to `to_height` (or tip)."""

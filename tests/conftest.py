@@ -2,12 +2,17 @@
 
 Real TPU hardware is single-chip in CI; multi-chip sharding is validated on
 virtual CPU devices (the driver separately dry-runs `dryrun_multichip`).
-Must set XLA flags before jax initializes.
+The real chip is exercised by the subprocess smoke test in
+tests/test_tpu_device.py and by bench.py.
+
+The environment pre-registers a TPU PJRT plugin and sets JAX_PLATFORMS
+before python starts, so overriding the env var here is NOT enough —
+jax.config.update('jax_platforms', ...) at import time is what actually
+pins the suite to CPU (it wins at first backend initialization).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
@@ -16,3 +21,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
