@@ -1,0 +1,13 @@
+from .height_vote_set import HeightVoteSet
+from .ticker import ManualTicker, TimeoutInfo, TimeoutTicker
+from .wal import WAL, EndHeightMessage, TimedWALMessage
+
+__all__ = [
+    "HeightVoteSet",
+    "ManualTicker",
+    "TimeoutInfo",
+    "TimeoutTicker",
+    "WAL",
+    "EndHeightMessage",
+    "TimedWALMessage",
+]

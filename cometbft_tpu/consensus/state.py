@@ -1,0 +1,745 @@
+"""The Tendermint consensus state machine.
+
+Behavior parity: reference internal/consensus/state.go —
+- the single-threaded receive loop processing peer messages, own messages,
+  and timeouts, WAL-logging each message BEFORE acting on it
+  (receiveRoutine :775-863; own messages fsync via WriteSync :830);
+- the step functions enterNewRound :1043, enterPropose :1130,
+  enterPrevote :1312, enterPrevoteWait, enterPrecommit :1514,
+  enterPrecommitWait, enterCommit :1649, tryFinalizeCommit :1712,
+  finalizeCommit :1740 with the lock/unlock/valid-block (POL) rules;
+- vote accounting addVote :2161 including last-commit precommits from the
+  previous height;
+- crash recovery: catchup_replay re-handles WAL records logged after the
+  last #ENDHEIGHT (reference internal/consensus/replay.go:94), with
+  signing idempotence delegated to the FilePV last-sign state.
+
+Gossip transport differences (deliberate, host-side design): proposals
+carry the full block in a companion BlockBytesMessage over the loopback /
+p2p channel instead of 64 KiB parts. The part-set machinery still defines
+BlockID (types/part_set.py); part-wise gossip plugs into
+_handle_block_bytes's seam when the p2p reactor lands.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+
+from ..state.execution import BlockExecutor, BlockValidationError, validate_block
+from ..types import (
+    Block,
+    BlockID,
+    Commit,
+    Proposal,
+    Timestamp,
+    ValidatorSet,
+    Vote,
+)
+from ..types.block import block_id_for
+from ..types.vote import SignedMsgType
+from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
+from .height_vote_set import HeightVoteSet
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import BlockBytesMessage, MsgInfo, TimeoutMessage, WAL
+
+
+class RoundStep(enum.IntEnum):
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+@dataclass
+class TimeoutConfig:
+    """Step timeouts (reference config/config.go ConsensusConfig defaults,
+    scaled down for in-process nets by tests)."""
+
+    propose: float = 3.0
+    propose_delta: float = 0.5
+    prevote: float = 1.0
+    prevote_delta: float = 0.5
+    precommit: float = 1.0
+    precommit_delta: float = 0.5
+    commit: float = 1.0
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.propose + self.propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.prevote + self.prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.precommit + self.precommit_delta * round_
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+class ConsensusState:
+    """One validator's consensus engine over an in-process transport."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        sm_state,
+        executor: BlockExecutor,
+        block_store,
+        privval,
+        wal: WAL,
+        broadcast=None,
+        timeouts: TimeoutConfig | None = None,
+        tx_source=None,
+        name: str = "",
+        now_ns=None,
+        ticker_factory=None,
+    ):
+        self.chain_id = chain_id
+        self.sm_state = sm_state
+        self.executor = executor
+        self.block_store = block_store
+        self.privval = privval
+        self.wal = wal
+        self.broadcast = broadcast or (lambda msg: None)
+        self.timeouts = timeouts or TimeoutConfig()
+        self.tx_source = tx_source or (lambda: [])
+        self.name = name or (privval.address().hex()[:8] if privval else "observer")
+        self.now_ns = now_ns or time.time_ns
+
+        self.inbox: queue.Queue = queue.Queue()
+        self.ticker = (ticker_factory or TimeoutTicker)(self._on_ticker_timeout)
+        self.evidence: list[ErrVoteConflictingVotes] = []
+        self.decided: dict[int, BlockID] = {}  # height -> committed block id
+        self._replay_mode = False
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._step_cv = threading.Condition()
+
+        # --- RoundState ---
+        self.height = sm_state.last_block_height + 1
+        self.round = 0
+        self.step = RoundStep.NEW_HEIGHT
+        self.validators: ValidatorSet = sm_state.validators.copy()
+        self.proposal: Proposal | None = None
+        self.proposal_block: Block | None = None
+        self.proposal_block_id: BlockID | None = None
+        self.locked_round = -1
+        self.locked_block: Block | None = None
+        self.locked_block_id: BlockID | None = None
+        self.valid_round = -1
+        self.valid_block: Block | None = None
+        self.valid_block_id: BlockID | None = None
+        self.votes = HeightVoteSet(chain_id, self.height, self.validators)
+        self.commit_round = -1
+        self.last_commit: VoteSet | None = None
+        self.triggered_timeout_precommit = False
+
+    # ==================================================================
+    # lifecycle
+    # ==================================================================
+    def reconstruct_last_commit(self) -> None:
+        """Rebuild the last-commit VoteSet from the stored seen commit
+        (reference state.go reconstructLastCommit) — restart path."""
+        h = self.sm_state.last_block_height
+        if h == 0 or self.block_store is None:
+            return
+        seen = self.block_store.load_seen_commit(h)
+        if seen is None:
+            return
+        vals = self.sm_state.last_validators
+        vs = VoteSet(self.chain_id, h, seen.round, SignedMsgType.PRECOMMIT, vals)
+        for idx, cs in enumerate(seen.signatures):
+            if cs.is_absent():
+                continue
+            vs.add_vote(
+                Vote(
+                    type=SignedMsgType.PRECOMMIT,
+                    height=h,
+                    round=seen.round,
+                    block_id=cs.effective_block_id(seen.block_id),
+                    timestamp=cs.timestamp,
+                    validator_address=cs.validator_address,
+                    validator_index=idx,
+                    signature=cs.signature,
+                ),
+                verify=False,  # stored commit was verified before saving
+            )
+        self.last_commit = vs
+
+    def start(self, replay_wal: bool = True) -> None:
+        if self.last_commit is None and self.height > self.sm_state.initial_height:
+            self.reconstruct_last_commit()
+        if replay_wal:
+            self.catchup_replay()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"cs-{self.name}")
+        self._thread.start()
+        self._schedule_round0_start()
+
+    def _schedule_round0_start(self):
+        # NewHeight -> round 0 after timeout_commit (immediately at genesis).
+        self.ticker.schedule(
+            TimeoutInfo(0.0, self.height, 0, int(RoundStep.NEW_HEIGHT))
+        )
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.ticker.stop()
+        self.inbox.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.wal.flush()
+
+    # ==================================================================
+    # inbound
+    # ==================================================================
+    def send(self, msg, peer_id: str) -> None:
+        """Deliver a message from a peer (thread-safe)."""
+        self.inbox.put(MsgInfo(msg, peer_id))
+
+    def _on_ticker_timeout(self, ti: TimeoutInfo) -> None:
+        self.inbox.put(ti)
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            item = self.inbox.get()
+            if item is None:
+                break
+            try:
+                self._process(item)
+            except Exception:  # noqa: BLE001 — reference panics halt chain
+                import traceback
+
+                traceback.print_exc()
+                self._stopped.set()
+                raise
+
+    def _process(self, item) -> None:
+        if isinstance(item, TimeoutInfo):
+            self.wal.write(
+                TimeoutMessage(ti_height(item), item.round, item.step)
+            )
+            self._handle_timeout(item)
+        elif isinstance(item, MsgInfo):
+            inner = item.msg
+            wal_msg = MsgInfo(_wal_payload(inner), item.peer_id)
+            if item.peer_id == "":
+                self.wal.write_sync(wal_msg)  # own msgs hit disk first
+                self._handle_msg(inner, item.peer_id)
+            else:
+                self.wal.write(wal_msg)
+                try:
+                    self._handle_msg(inner, item.peer_id)
+                except Exception:
+                    # A malformed peer message must never halt consensus
+                    # (reference drops it and punishes the peer); only our
+                    # own messages are trusted to be well-formed.
+                    pass
+        with self._step_cv:
+            self._step_cv.notify_all()
+
+    def _handle_msg(self, msg, peer_id: str) -> None:
+        if isinstance(msg, (VoteMessage, Vote)):
+            self._handle_vote(msg.vote if isinstance(msg, VoteMessage) else msg,
+                              peer_id)
+        elif isinstance(msg, (ProposalMessage, Proposal)):
+            self._handle_proposal(
+                msg.proposal if isinstance(msg, ProposalMessage) else msg, peer_id
+            )
+        elif isinstance(msg, BlockBytesMessage):
+            self._handle_block_bytes(msg, peer_id)
+        else:
+            raise TypeError(f"unknown consensus message {type(msg)}")
+
+    # ==================================================================
+    # handlers
+    # ==================================================================
+    def _handle_proposal(self, p: Proposal, peer_id: str) -> None:
+        # reference defaultSetProposal (state.go:1876)
+        if self.proposal is not None:
+            return
+        if p.height != self.height or p.round != self.round:
+            return
+        p.basic_validate()
+        proposer = self.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            p.sign_bytes(self.chain_id), p.signature
+        ):
+            raise BlockValidationError("invalid proposal signature")
+        self.proposal = p
+        if (
+            self.proposal_block is not None
+            and self.proposal_block_id is not None
+            and self.proposal_block_id == p.block_id
+        ):
+            self._on_complete_proposal()
+
+    def _handle_block_bytes(self, bb: BlockBytesMessage, peer_id: str) -> None:
+        if bb.height != self.height:
+            return
+        if self.proposal_block is not None:
+            return
+        block = Block.decode(bb.block_bytes)
+        bid = block_id_for(block)
+        committed_id = None
+        if self.commit_round >= 0:
+            committed_id, _ = self.votes.precommits(self.commit_round).two_thirds_majority()
+        wanted = (self.proposal is not None and bid == self.proposal.block_id) or (
+            committed_id is not None and bid == committed_id
+        )
+        if not wanted and self.proposal is not None:
+            return  # not the block we're looking for; drop
+        self.proposal_block = block
+        self.proposal_block_id = bid
+        if self.proposal is not None and bid == self.proposal.block_id:
+            self._on_complete_proposal()
+        elif committed_id is not None and bid == committed_id:
+            self._try_finalize_commit(self.height)
+
+    def _on_complete_proposal(self) -> None:
+        # reference handleCompleteProposal (state.go:2045)
+        if self.step == RoundStep.PROPOSE:
+            self.enter_prevote(self.height, self.round)
+        elif self.step == RoundStep.COMMIT or self.commit_round >= 0:
+            self._try_finalize_commit(self.height)
+
+    def _handle_vote(self, v: Vote, peer_id: str) -> None:
+        # reference tryAddVote/addVote (state.go:2095,2161)
+        if v.height + 1 == self.height and v.type == SignedMsgType.PRECOMMIT:
+            if self.step != RoundStep.NEW_HEIGHT or self.last_commit is None:
+                return
+            try:
+                self.last_commit.add_vote(v)
+            except ErrVoteConflictingVotes as e:
+                self.evidence.append(e)
+            except Exception:
+                pass
+            return
+        if v.height != self.height:
+            return
+        try:
+            added = self.votes.add_vote(v, peer_id)
+        except ErrVoteConflictingVotes as e:
+            self.evidence.append(e)
+            pool = getattr(self.executor, "evidence_pool", None)
+            if pool is not None:  # reference evidencePool.ReportConflictingVotes
+                pool.report_conflicting_votes(e.vote_a, e.vote_b)
+            if not e.added:
+                return
+            added = True
+        except Exception:
+            if peer_id == "":
+                raise  # own vote must never be invalid
+            return  # bad peer vote: drop (peer punishment at p2p layer)
+        if not added:
+            return
+
+        if v.type == SignedMsgType.PREVOTE:
+            self._after_prevote(v)
+        else:
+            self._after_precommit(v)
+
+    def _after_prevote(self, v: Vote) -> None:
+        prevotes = self.votes.prevotes(v.round)
+        maj, ok = prevotes.two_thirds_majority()
+        if ok:
+            # unlock on a later-round POL for a different block (state.go:2230)
+            if (
+                self.locked_block is not None
+                and self.locked_round < v.round <= self.round
+                and self.locked_block_id != maj
+            ):
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_id = None
+            # track the most recent possible valid block (state.go:2246)
+            if (
+                not maj.is_zero()
+                and (self.valid_round < v.round)
+                and v.round == self.round
+            ):
+                if self.proposal_block_id == maj:
+                    self.valid_round = v.round
+                    self.valid_block = self.proposal_block
+                    self.valid_block_id = maj
+
+        if self.round < v.round and prevotes.has_two_thirds_any():
+            self.enter_new_round(self.height, v.round)
+        elif self.round == v.round and self.step >= RoundStep.PREVOTE:
+            if ok and (maj.is_zero() or maj == self.proposal_block_id
+                       or maj == self.locked_block_id):
+                self.enter_precommit(self.height, v.round)
+            elif prevotes.has_two_thirds_any() and self.step == RoundStep.PREVOTE:
+                self.enter_prevote_wait(self.height, v.round)
+        elif (
+            self.proposal is not None
+            and 0 <= self.proposal.pol_round == v.round
+            and self.step == RoundStep.PROPOSE
+            and self._proposal_complete()
+        ):
+            self.enter_prevote(self.height, self.round)
+
+    def _after_precommit(self, v: Vote) -> None:
+        precommits = self.votes.precommits(v.round)
+        maj, ok = precommits.two_thirds_majority()
+        if ok:
+            self.enter_new_round(self.height, v.round)
+            self.enter_precommit(self.height, v.round)
+            if not maj.is_zero():
+                self.enter_commit(self.height, v.round)
+            else:
+                self.enter_precommit_wait(self.height, v.round)
+        elif self.round <= v.round and precommits.has_two_thirds_any():
+            self.enter_new_round(self.height, v.round)
+            self.enter_precommit_wait(self.height, v.round)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        # reference handleTimeout (state.go:982)
+        if ti.height != self.height:
+            return
+        step = RoundStep(ti.step)
+        if step == RoundStep.NEW_HEIGHT:
+            self.enter_new_round(self.height, 0)
+            return
+        if ti.round < self.round or (
+            ti.round == self.round and step < self.step
+        ):
+            return
+        if step == RoundStep.PROPOSE:
+            self.enter_prevote(self.height, ti.round)
+        elif step == RoundStep.PREVOTE_WAIT:
+            self.enter_precommit(self.height, ti.round)
+        elif step == RoundStep.PRECOMMIT_WAIT:
+            self.enter_precommit(self.height, ti.round)
+            self.enter_new_round(self.height, ti.round + 1)
+
+    # ==================================================================
+    # step functions
+    # ==================================================================
+    def _update_step(self, round_: int, step: RoundStep) -> None:
+        self.round = round_
+        self.step = step
+
+    def enter_new_round(self, h: int, r: int) -> None:
+        if h != self.height or r < self.round or (
+            r == self.round and self.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        if r > self.round:
+            self.validators.increment_proposer_priority(r - self.round)
+        self._update_step(r, RoundStep.NEW_ROUND)
+        self.triggered_timeout_precommit = False
+        if r != 0:
+            self.proposal = None
+            self.proposal_block = None
+            self.proposal_block_id = None
+        self.votes.set_round(r + 1)
+        self.enter_propose(h, r)
+
+    def enter_propose(self, h: int, r: int) -> None:
+        if h != self.height or r < self.round or (
+            r == self.round and self.step >= RoundStep.PROPOSE
+        ):
+            return
+        self._update_step(r, RoundStep.PROPOSE)
+        self.ticker.schedule(
+            TimeoutInfo(self.timeouts.propose_timeout(r), h, r,
+                        int(RoundStep.PROPOSE))
+        )
+        if self._proposal_complete():
+            self.enter_prevote(h, r)
+            return
+        if self.privval is None:
+            return
+        proposer = self.validators.get_proposer()
+        if proposer.address != self.privval.address():
+            return
+        # --- we are the proposer (defaultDecideProposal, state.go:1180) ---
+        if self.valid_block is not None:
+            block, bid = self.valid_block, self.valid_block_id
+        else:
+            last_commit = self._last_commit_for_proposal()
+            block = self.executor.create_proposal_block(
+                h, self.sm_state, last_commit, proposer.address,
+                self.tx_source(),
+                block_time=self._proposal_block_time(),
+            )
+            bid = block_id_for(block)
+        proposal = Proposal(
+            height=h, round=r, pol_round=self.valid_round, block_id=bid,
+            timestamp=Timestamp.from_unix_ns(self.now_ns()),
+        )
+        self.privval.sign_proposal(self.chain_id, proposal)
+        bb = BlockBytesMessage(h, r, block.encode())
+        if not self._replay_mode:
+            self.broadcast(ProposalMessage(proposal))
+            self.broadcast(bb)
+        self.send(ProposalMessage(proposal), "")
+        self.send(bb, "")
+
+    def _proposal_block_time(self) -> Timestamp:
+        if self.height == self.sm_state.initial_height:
+            return self.sm_state.last_block_time
+        return Timestamp.from_unix_ns(self.now_ns())
+
+    def _last_commit_for_proposal(self) -> Commit:
+        if self.height == self.sm_state.initial_height:
+            return Commit()
+        assert self.last_commit is not None, "no last commit at height > initial"
+        return self.last_commit.make_commit()
+
+    def _proposal_complete(self) -> bool:
+        return (
+            self.proposal is not None
+            and self.proposal_block is not None
+            and self.proposal_block_id == self.proposal.block_id
+        )
+
+    def enter_prevote(self, h: int, r: int) -> None:
+        if h != self.height or r < self.round or (
+            r == self.round and self.step >= RoundStep.PREVOTE
+        ):
+            return
+        self._update_step(r, RoundStep.PREVOTE)
+        # defaultDoPrevote (state.go:1365)
+        if self.locked_block is not None:
+            self._sign_and_send_vote(SignedMsgType.PREVOTE, self.locked_block_id)
+            return
+        if self.proposal_block is None or not self._proposal_complete():
+            self._sign_and_send_vote(SignedMsgType.PREVOTE, BlockID())
+            return
+        try:
+            validate_block(
+                self.sm_state, self.proposal_block,
+                backend=self.executor.backend,
+            )
+            app_accepts = self.executor.process_proposal(self.proposal_block)
+        except BlockValidationError:
+            app_accepts = False
+        self._sign_and_send_vote(
+            SignedMsgType.PREVOTE,
+            self.proposal_block_id if app_accepts else BlockID(),
+        )
+
+    def enter_prevote_wait(self, h: int, r: int) -> None:
+        if h != self.height or r < self.round or (
+            r == self.round and self.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        self._update_step(r, RoundStep.PREVOTE_WAIT)
+        self.ticker.schedule(
+            TimeoutInfo(self.timeouts.prevote_timeout(r), h, r,
+                        int(RoundStep.PREVOTE_WAIT))
+        )
+
+    def enter_precommit(self, h: int, r: int) -> None:
+        if h != self.height or r < self.round or (
+            r == self.round and self.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self._update_step(r, RoundStep.PRECOMMIT)
+        prevotes = self.votes.prevotes(r)
+        maj, ok = prevotes.two_thirds_majority()
+        if not ok:
+            self._sign_and_send_vote(SignedMsgType.PRECOMMIT, BlockID())
+            return
+        if maj.is_zero():
+            if self.locked_block is not None:
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_id = None
+            self._sign_and_send_vote(SignedMsgType.PRECOMMIT, BlockID())
+            return
+        if self.locked_block_id == maj:
+            self.locked_round = r  # relock
+            self._sign_and_send_vote(SignedMsgType.PRECOMMIT, maj)
+            return
+        if self.proposal_block_id == maj and self._proposal_complete():
+            try:
+                validate_block(
+                    self.sm_state, self.proposal_block,
+                    backend=self.executor.backend,
+                )
+            except BlockValidationError as e:
+                raise RuntimeError(f"+2/3 prevoted an invalid block: {e}") from e
+            self.locked_round = r
+            self.locked_block = self.proposal_block
+            self.locked_block_id = maj
+            self._sign_and_send_vote(SignedMsgType.PRECOMMIT, maj)
+            return
+        # +2/3 for a block we don't have: precommit nil, mark valid
+        self.valid_round = r
+        self.valid_block = None
+        self.valid_block_id = maj
+        self._sign_and_send_vote(SignedMsgType.PRECOMMIT, BlockID())
+
+    def enter_precommit_wait(self, h: int, r: int) -> None:
+        # Reference enterPrecommitWait: does NOT change the step; a
+        # triggered flag prevents each extra precommit from restarting the
+        # timer (TriggeredTimeoutPrecommit, reference state.go:1614).
+        if h != self.height or r != self.round or self.triggered_timeout_precommit:
+            return
+        self.triggered_timeout_precommit = True
+        self.ticker.schedule(
+            TimeoutInfo(self.timeouts.precommit_timeout(r), h, r,
+                        int(RoundStep.PRECOMMIT_WAIT))
+        )
+
+    def enter_commit(self, h: int, r: int) -> None:
+        if h != self.height or self.step == RoundStep.COMMIT:
+            return
+        self._update_step(self.round, RoundStep.COMMIT)
+        self.commit_round = r
+        maj, ok = self.votes.precommits(r).two_thirds_majority()
+        assert ok and not maj.is_zero()
+        if self.locked_block_id == maj:
+            self.proposal_block = self.locked_block
+            self.proposal_block_id = self.locked_block_id
+        elif self.proposal_block_id != maj:
+            # clear a mismatched proposal block so the committed one can
+            # arrive via gossip (reference enterCommit sets ProposalBlock
+            # to nil + fresh parts for the committed BlockID)
+            self.proposal_block = None
+            self.proposal_block_id = None
+        self._try_finalize_commit(h)
+
+    def _try_finalize_commit(self, h: int) -> None:
+        if self.commit_round < 0:
+            return
+        maj, ok = self.votes.precommits(self.commit_round).two_thirds_majority()
+        if not ok or maj.is_zero():
+            return
+        if self.proposal_block_id != maj or self.proposal_block is None:
+            return  # waiting for the block to arrive
+        self._finalize_commit(h, maj)
+
+    def _finalize_commit(self, h: int, maj: BlockID) -> None:
+        # reference finalizeCommit (state.go:1740)
+        block = self.proposal_block
+        precommits = self.votes.precommits(self.commit_round)
+        seen_commit = precommits.make_commit()
+        if self.block_store is not None:
+            self.block_store.save_block(block, seen_commit)
+        self.wal.write_end_height(h)
+        new_state = self.executor.apply_block(
+            self.sm_state, maj, block,
+        )
+        self.decided[h] = maj
+        self._update_to_state(new_state, precommits)
+
+    def _update_to_state(self, new_state, last_precommits: VoteSet) -> None:
+        self.sm_state = new_state
+        self.height = new_state.last_block_height + 1
+        self.validators = new_state.validators.copy()
+        self._update_step(0, RoundStep.NEW_HEIGHT)
+        self.round = 0
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_id = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_id = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.valid_block_id = None
+        self.commit_round = -1
+        self.last_commit = last_precommits
+        self.triggered_timeout_precommit = False
+        self.votes = HeightVoteSet(self.chain_id, self.height, self.validators)
+        self.ticker.schedule(
+            TimeoutInfo(self.timeouts.commit, self.height, 0,
+                        int(RoundStep.NEW_HEIGHT))
+        )
+
+    # ==================================================================
+    # voting
+    # ==================================================================
+    def _sign_and_send_vote(self, vtype: SignedMsgType, block_id: BlockID) -> None:
+        if self.privval is None:
+            return
+        idx, val = self.validators.get_by_address(self.privval.address())
+        if val is None:
+            return
+        vote = Vote(
+            type=vtype,
+            height=self.height,
+            round=self.round,
+            block_id=block_id or BlockID(),
+            timestamp=Timestamp.from_unix_ns(self.now_ns()),
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        self.privval.sign_vote(self.chain_id, vote)
+        if not self._replay_mode:
+            self.broadcast(VoteMessage(vote))
+        self.send(VoteMessage(vote), "")
+
+    # ==================================================================
+    # WAL crash recovery
+    # ==================================================================
+    def catchup_replay(self) -> None:
+        """Re-handle messages logged after the last #ENDHEIGHT
+        (reference internal/consensus/replay.go:94)."""
+        msgs = self.wal.search_for_end_height(self.height - 1)
+        if msgs is None:
+            if self.height - 1 > 0:
+                return  # fresh WAL beyond genesis: nothing to replay
+            msgs = []
+        self._replay_mode = True
+        try:
+            for tm in msgs:
+                m = tm.msg
+                if isinstance(m, MsgInfo):
+                    try:
+                        self._handle_msg(m.msg, m.peer_id)
+                    except Exception:
+                        pass  # tolerate stale/duplicate replay artifacts
+                elif isinstance(m, TimeoutMessage):
+                    try:
+                        self._handle_timeout(
+                            TimeoutInfo(0.0, m.height, m.round, m.step)
+                        )
+                    except Exception:
+                        pass
+        finally:
+            self._replay_mode = False
+
+    # ==================================================================
+    # test helpers
+    # ==================================================================
+    def wait_for_height(self, h: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._step_cv:
+            while self.height < h:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped.is_set():
+                    return self.height >= h
+                self._step_cv.wait(remaining)
+        return True
+
+
+def ti_height(ti: TimeoutInfo) -> int:
+    return ti.height
+
+
+def _wal_payload(msg):
+    if isinstance(msg, VoteMessage):
+        return msg.vote
+    if isinstance(msg, ProposalMessage):
+        return msg.proposal
+    return msg

@@ -18,8 +18,6 @@ happens on-device inside the batch, where it is amortized across lanes.
 
 from __future__ import annotations
 
-import hashlib
-
 import numpy as np
 
 from . import ed25519_ref as ref
@@ -88,13 +86,6 @@ class Ed25519PrivKey(PrivKey):
         return KEY_TYPE
 
 
-def _nibble_windows(b32: np.ndarray) -> np.ndarray:
-    """(B, 32) uint8 -> (B, 64) int32 little-endian 4-bit windows."""
-    lo = (b32 & 15).astype(np.int32)
-    hi = (b32 >> 4).astype(np.int32)
-    return np.stack([lo, hi], axis=-1).reshape(b32.shape[0], 64)
-
-
 def _bucket(n: int) -> int:
     for b in BUCKETS:
         if n <= b:
@@ -141,29 +132,45 @@ class Ed25519BatchVerifier(BatchVerifier):
         import jax.numpy as jnp
 
         from ..ops.ed25519_verify import verify_batch_jit
+        from ..ops.sha512 import pad_messages
+
+        from ..ops.sha512 import MAX_INPUT_BYTES
 
         n = len(self._items)
         b = _bucket(n)
         a_bytes = np.zeros((b, 32), np.uint8)
         r_bytes = np.zeros((b, 32), np.uint8)
         s_raw = np.zeros((b, 32), np.uint8)
-        k_raw = np.zeros((b, 32), np.uint8)
         live = np.zeros((b,), bool)
+        live[:n] = True
+        preimages = []
+        oversize: list[int] = []  # device hash kernel is 2-block-bounded
         for i, (pub, msg, sig) in enumerate(self._items):
             a_bytes[i] = np.frombuffer(pub, np.uint8)
-            r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
-            s_raw[i] = np.frombuffer(sig[32:], np.uint8)
-            k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % ref.L
-            k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
-            live[i] = True
+            r_bytes[i] = np.frombuffer(sig, np.uint8, count=32)
+            s_raw[i] = np.frombuffer(sig, np.uint8, count=32, offset=32)
+            pre = sig[:32] + pub + msg
+            if len(pre) > MAX_INPUT_BYTES:
+                oversize.append(i)
+                pre = b""
+                live[i] = False
+            preimages.append(pre)
+        msg_words = np.zeros((b, 64), np.uint32)
+        two_blocks = np.zeros((b,), bool)
+        msg_words[:n], two_blocks[:n] = pad_messages(preimages)
         out = verify_batch_jit(
             jnp.asarray(a_bytes),
             jnp.asarray(r_bytes),
-            jnp.asarray(_nibble_windows(s_raw)),
-            jnp.asarray(_nibble_windows(k_raw)),
+            jnp.asarray(s_raw),
+            jnp.asarray(msg_words),
+            jnp.asarray(two_blocks),
             jnp.asarray(live),
         )
-        return np.asarray(out)[:n]
+        bits = np.asarray(out)[:n].copy()
+        for i in oversize:  # rare long messages: host fallback
+            pub, msg, sig = self._items[i]
+            bits[i] = ref.verify(pub, msg, sig)
+        return bits
 
 
 def batch_verifier(backend: str = "tpu") -> Ed25519BatchVerifier:

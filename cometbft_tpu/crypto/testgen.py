@@ -29,15 +29,12 @@ def generate_signed_batch(n: int, seed: int = 0, msg_len: int = 120):
     r_sc = [int.from_bytes(rng.bytes(32), "little") % ref.L for _ in range(n)]
     msgs = [rng.bytes(msg_len) for _ in range(n)]
 
-    zeros = jnp.zeros((n, 64), jnp.int32)
-    ident = C.identity(n)
-
     @jax.jit
-    def fixed_base_compress(wins):
-        return C.compress(C.shamir(wins, zeros, ident))
+    def fixed_base_compress(digs):
+        return C.compress(C.fixed_base(digs))
 
-    a_enc = np.asarray(fixed_base_compress(jnp.asarray(C.scalar_windows(a_sc))))
-    r_enc = np.asarray(fixed_base_compress(jnp.asarray(C.scalar_windows(r_sc))))
+    a_enc = np.asarray(fixed_base_compress(jnp.asarray(C.scalar_digits(a_sc))))
+    r_enc = np.asarray(fixed_base_compress(jnp.asarray(C.scalar_digits(r_sc))))
 
     out = []
     for i in range(n):

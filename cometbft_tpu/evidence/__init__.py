@@ -1,0 +1,3 @@
+from .pool import EvidencePool
+
+__all__ = ["EvidencePool"]

@@ -1,0 +1,28 @@
+from .types import LightBlock, SignedHeader
+from .verifier import (
+    ErrHeaderExpired,
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    verify,
+    verify_adjacent,
+    verify_non_adjacent,
+    verify_stream,
+)
+from .client import LightClient, Provider, StoreProvider
+from .store import LightStore
+
+__all__ = [
+    "LightBlock",
+    "SignedHeader",
+    "ErrHeaderExpired",
+    "ErrInvalidHeader",
+    "ErrNewValSetCantBeTrusted",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+    "verify_stream",
+    "LightClient",
+    "Provider",
+    "StoreProvider",
+    "LightStore",
+]

@@ -1,0 +1,215 @@
+"""Light client: trusted store + primary/witness providers + bisection.
+
+Behavior parity: reference light/client.go —
+- TrustOptions anchor (:210 initialize from a trusted height+hash),
+- sequential verification (:613 verifySequential),
+- skipping/bisection verification (:706 verifySkipping: try non-adjacent
+  from the latest trusted; on ErrNewValSetCantBeTrusted bisect midpoint),
+- witness cross-checking (detector.go compareFirstHeaderWithWitnesses):
+  after verification the new header is compared against every witness;
+  a mismatch raises ErrConflictingHeaders (attack evidence handling is
+  the evidence pool's job),
+- pruning (:76 PruningSize).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ..types import Timestamp
+from .store import LightStore
+from .types import LightBlock
+from .verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+
+
+class Provider(ABC):
+    """Source of light blocks (reference light/provider/provider.go)."""
+
+    @abstractmethod
+    def light_block(self, height: int) -> LightBlock | None: ...
+
+    @abstractmethod
+    def chain_id(self) -> str: ...
+
+
+class StoreProvider(Provider):
+    """Provider over a local block/state store pair (tests, inspect mode)."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self._blocks = block_store
+        self._states = state_store
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock | None:
+        from ..types.block import block_id_for
+        from .types import SignedHeader
+
+        block = self._blocks.load_block(height)
+        commit = self._blocks.load_block_commit(height)
+        if commit is None:
+            commit = self._blocks.load_seen_commit(height)
+        vals = self._states.load_validators(height)
+        if block is None or commit is None or vals is None:
+            return None
+        return LightBlock(SignedHeader(block.header, commit), vals)
+
+
+class ErrConflictingHeaders(Exception):
+    def __init__(self, witness_idx: int, height: int):
+        super().__init__(
+            f"witness {witness_idx} disagrees at height {height} — "
+            "possible light-client attack"
+        )
+        self.witness_idx = witness_idx
+        self.height = height
+
+
+class LightClient:
+    def __init__(
+        self,
+        chain_id: str,
+        primary: Provider,
+        witnesses: list[Provider] | None = None,
+        store: LightStore | None = None,
+        trusting_period_s: int = 14 * 24 * 3600,
+        trust_level: tuple[int, int] = DEFAULT_TRUST_LEVEL,
+        max_clock_drift_s: float = 10.0,
+        pruning_size: int = 1000,
+        backend: str = "tpu",
+        skipping: bool = True,
+    ):
+        self.chain_id = chain_id
+        self.primary = primary
+        self.witnesses = witnesses or []
+        self.store = store or LightStore()
+        self.trusting_period_s = trusting_period_s
+        self.trust_level = trust_level
+        self.max_clock_drift_s = max_clock_drift_s
+        self.pruning_size = pruning_size
+        self.backend = backend
+        self.skipping = skipping
+
+    # ------------------------------------------------------------------
+    def initialize(self, height: int, header_hash: bytes) -> LightBlock:
+        """Trust anchor: fetch height from primary, check the hash matches
+        (reference light/client.go initializeWithTrustOptions)."""
+        lb = self.primary.light_block(height)
+        if lb is None:
+            raise ErrInvalidHeader(f"primary has no light block at {height}")
+        lb.basic_validate(self.chain_id)
+        if lb.signed_header.header.hash() != header_hash:
+            raise ErrInvalidHeader("trusted hash mismatch at anchor height")
+        self.store.save(lb)
+        return lb
+
+    # ------------------------------------------------------------------
+    def verify_to_height(self, height: int, now: Timestamp) -> LightBlock:
+        latest = self.store.latest()
+        if latest is None:
+            raise ErrInvalidHeader("client not initialized (no trusted block)")
+        if height <= latest.height:
+            got = self.store.load(height)
+            if got is not None:
+                return got
+            raise ErrInvalidHeader(f"height {height} below trusted, not stored")
+        target = self.primary.light_block(height)
+        if target is None:
+            raise ErrInvalidHeader(f"primary has no light block at {height}")
+        if self.skipping:
+            out = self._verify_skipping(latest, target, now)
+        else:
+            out = self._verify_sequential(latest, target, now)
+        self._cross_check(out)
+        self.store.prune(self.pruning_size)
+        return out
+
+    # ------------------------------------------------------------------
+    def _verify_one(self, trusted: LightBlock, new: LightBlock, now: Timestamp
+                    ) -> None:
+        if new.height == trusted.height + 1:
+            verify_adjacent(
+                self.chain_id, trusted.signed_header, new.signed_header,
+                new.validators, self.trusting_period_s, now,
+                self.max_clock_drift_s, self.backend,
+            )
+        else:
+            # trusted NEXT validators: adjacent header's set is hashed in
+            # the trusted header; for trusting verification the reference
+            # uses the trusted block's NextValidators — our LightBlock
+            # carries the current set, so fetch next via the primary's
+            # height+1... the trusted header's next_validators_hash pins it.
+            verify_non_adjacent(
+                self.chain_id, trusted.signed_header,
+                self._next_validators(trusted), new.signed_header,
+                new.validators, self.trusting_period_s, now,
+                self.trust_level, self.max_clock_drift_s, self.backend,
+            )
+
+    def _next_validators(self, lb: LightBlock):
+        nxt = self.primary.light_block(lb.height + 1)
+        if nxt is not None and (
+            nxt.validators.hash() == lb.signed_header.header.next_validators_hash
+        ):
+            return nxt.validators
+        # fall back to the current set (valid when the set is unchanged)
+        if lb.validators.hash() == lb.signed_header.header.next_validators_hash:
+            return lb.validators
+        raise ErrInvalidHeader(
+            f"cannot obtain next validator set for height {lb.height}"
+        )
+
+    def _verify_sequential(self, trusted: LightBlock, target: LightBlock,
+                           now: Timestamp) -> LightBlock:
+        cur = trusted
+        for h in range(trusted.height + 1, target.height):
+            nxt = self.primary.light_block(h)
+            if nxt is None:
+                raise ErrInvalidHeader(f"primary missing height {h}")
+            self._verify_one(cur, nxt, now)
+            self.store.save(nxt)
+            cur = nxt
+        self._verify_one(cur, target, now)
+        self.store.save(target)
+        return target
+
+    def _verify_skipping(self, trusted: LightBlock, target: LightBlock,
+                         now: Timestamp) -> LightBlock:
+        """Bisection (reference light/client.go:706 verifySkipping)."""
+        cur = trusted
+        pivots = [target]
+        while pivots:
+            pivot = pivots[-1]
+            try:
+                self._verify_one(cur, pivot, now)
+            except ErrNewValSetCantBeTrusted:
+                mid = (cur.height + pivot.height) // 2
+                if mid in (cur.height, pivot.height):
+                    raise
+                mid_lb = self.primary.light_block(mid)
+                if mid_lb is None:
+                    raise ErrInvalidHeader(f"primary missing pivot height {mid}")
+                pivots.append(mid_lb)
+                continue
+            self.store.save(pivot)
+            cur = pivot
+            pivots.pop()
+        return cur
+
+    # ------------------------------------------------------------------
+    def _cross_check(self, lb: LightBlock) -> None:
+        want = lb.signed_header.header.hash()
+        for i, w in enumerate(self.witnesses):
+            other = w.light_block(lb.height)
+            if other is None:
+                continue  # witness lagging: reference retries/drops it
+            if other.signed_header.header.hash() != want:
+                raise ErrConflictingHeaders(i, lb.height)

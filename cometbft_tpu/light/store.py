@@ -1,0 +1,99 @@
+"""Trusted light-block store (reference light/store/db/db.go)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..encoding import proto as pb
+from ..storage.kv import KVStore, MemKV
+from ..types import Commit, Header, Validator, ValidatorSet
+from ..types.validator_set import encode_pub_key
+from ..crypto.ed25519 import Ed25519PubKey
+from .types import LightBlock, SignedHeader
+
+
+def _key(h: int) -> bytes:
+    return b"LB:" + h.to_bytes(8, "big")
+
+
+def _encode_vals(vals: ValidatorSet) -> bytes:
+    out = b""
+    for v in vals.validators:
+        out += pb.f_embedded(
+            1,
+            pb.f_bytes(1, v.pub_key.bytes())
+            + pb.f_varint(2, v.voting_power)
+            + pb.f_varint(3, v.proposer_priority + (1 << 62)),  # offset-encode
+        )
+    return out
+
+
+def _decode_vals(buf: bytes) -> ValidatorSet:
+    vals = []
+    for f, _, v in pb.parse_fields(buf):
+        if f != 1:
+            continue
+        d = pb.fields_to_dict(bytes(v))
+        val = Validator.from_pub_key(
+            Ed25519PubKey(bytes(d.get(1, b""))), pb.to_i64(d.get(2, 0))
+        )
+        val.proposer_priority = pb.to_i64(d.get(3, 0)) - (1 << 62)
+        vals.append(val)
+    return ValidatorSet(vals, increment_first=False)
+
+
+class LightStore:
+    """Height-keyed store of verified LightBlocks with pruning."""
+
+    def __init__(self, db: KVStore | None = None):
+        self._db = db or MemKV()
+        self._lock = threading.Lock()
+        self._heights: list[int] = []
+
+    def save(self, lb: LightBlock) -> None:
+        payload = pb.f_embedded(1, lb.signed_header.encode()) + pb.f_embedded(
+            2, _encode_vals(lb.validators)
+        )
+        with self._lock:
+            self._db.set(_key(lb.height), payload)
+            if lb.height not in self._heights:
+                import bisect
+
+                bisect.insort(self._heights, lb.height)
+
+    def load(self, height: int) -> LightBlock | None:
+        raw = self._db.get(_key(height))
+        if not raw:
+            return None
+        d = pb.fields_to_dict(raw)
+        return LightBlock(
+            SignedHeader.decode(bytes(d.get(1, b""))),
+            _decode_vals(bytes(d.get(2, b""))),
+        )
+
+    def latest(self) -> LightBlock | None:
+        with self._lock:
+            if not self._heights:
+                return None
+            h = self._heights[-1]
+        return self.load(h)
+
+    def lowest(self) -> LightBlock | None:
+        with self._lock:
+            if not self._heights:
+                return None
+            h = self._heights[0]
+        return self.load(h)
+
+    def heights(self) -> list[int]:
+        with self._lock:
+            return list(self._heights)
+
+    def prune(self, keep: int) -> int:
+        """Keep the newest `keep` blocks (reference PruningSize)."""
+        with self._lock:
+            drop = self._heights[:-keep] if keep else list(self._heights)
+            self._heights = self._heights[-keep:] if keep else []
+            for h in drop:
+                self._db.delete(_key(h))
+            return len(drop)

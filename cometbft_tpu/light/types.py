@@ -1,0 +1,63 @@
+"""Light client data types (reference types/light.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..encoding import proto as pb
+from ..types import Commit, Header, ValidatorSet
+
+
+@dataclass
+class SignedHeader:
+    """Header + the commit that signed it (reference types/light.go:83)."""
+
+    header: Header
+    commit: Commit
+
+    def basic_validate(self, chain_id: str) -> None:
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header chain id {self.header.chain_id!r} != {chain_id!r}"
+            )
+        if self.commit.height != self.header.height:
+            raise ValueError("commit height != header height")
+        from ..types.basic import BlockID
+
+        if self.commit.block_id.hash != self.header.hash():
+            raise ValueError("commit signs a different header")
+
+    def encode(self) -> bytes:
+        return pb.f_embedded(1, self.header.encode()) + pb.f_embedded(
+            2, self.commit.encode()
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "SignedHeader":
+        d = pb.fields_to_dict(buf)
+        return cls(
+            Header.decode(bytes(d.get(1, b""))),
+            Commit.decode(bytes(d.get(2, b""))),
+        )
+
+
+@dataclass
+class LightBlock:
+    """SignedHeader + the validator set of that height
+    (reference types/light.go:12)."""
+
+    signed_header: SignedHeader
+    validators: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.header.height
+
+    @property
+    def time(self):
+        return self.signed_header.header.time
+
+    def basic_validate(self, chain_id: str) -> None:
+        self.signed_header.basic_validate(chain_id)
+        if self.signed_header.header.validators_hash != self.validators.hash():
+            raise ValueError("validator set does not match header validators_hash")

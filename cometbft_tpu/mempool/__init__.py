@@ -1,0 +1,3 @@
+from .mempool import CListMempool, LRUTxCache, NopMempool, TxKey
+
+__all__ = ["CListMempool", "LRUTxCache", "NopMempool", "TxKey"]

@@ -1,0 +1,206 @@
+"""Transaction mempool.
+
+Behavior parity: reference mempool/clist_mempool.go —
+- CheckTx admission through the app's mempool connection (:252 CheckTx,
+  :389 resCbFirstTime): only code==OK txs enter the pool; everything seen
+  recently sits in an LRU dedup cache (mempool/cache.go:35 LRUTxCache).
+- Ordering: FIFO insertion order (the reference's concurrent linked list
+  collapses to an ordered dict under Python's GIL; the wait/gossip seam
+  is the on_new_tx callbacks).
+- Reap honors max_bytes/max_gas (:~500 ReapMaxBytesMaxGas).
+- Update after a committed block (:~560): committed txs leave the pool
+  (and stay in cache so peers can't replay them); survivors are
+  re-CheckTx'd (recheck) because the app state changed.
+- Lock/Unlock around proposal creation + update (reference Mempool
+  interface, mempool/mempool.go:145).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+
+def TxKey(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+class LRUTxCache:
+    """Fixed-size LRU of tx keys (reference mempool/cache.go:35)."""
+
+    def __init__(self, size: int = 10000):
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, key: bytes) -> bool:
+        """False if already present (moves it to front like the reference)."""
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, key: bytes) -> None:
+        with self._lock:
+            self._map.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+@dataclass
+class _MempoolTx:
+    tx: bytes
+    height: int  # height when admitted
+    gas_wanted: int
+
+
+class ErrTxInCache(Exception):
+    pass
+
+
+class ErrMempoolFull(Exception):
+    def __init__(self, size, max_size):
+        super().__init__(f"mempool full: {size} >= {max_size}")
+
+
+class ErrTxTooLarge(Exception):
+    pass
+
+
+class CListMempool:
+    def __init__(
+        self,
+        app_conns,
+        max_txs: int = 5000,
+        max_tx_bytes: int = 1024 * 1024,
+        cache_size: int = 10000,
+        keep_invalid_txs_in_cache: bool = False,
+    ):
+        self.app = app_conns
+        self.max_txs = max_txs
+        self.max_tx_bytes = max_tx_bytes
+        self.keep_invalid = keep_invalid_txs_in_cache
+        self.cache = LRUTxCache(cache_size)
+        self._txs: OrderedDict[bytes, _MempoolTx] = OrderedDict()
+        self._lock = threading.RLock()  # the consensus Lock/Unlock seam
+        self.height = 0
+        self.on_new_tx: list = []  # gossip seam (p2p reactor subscribes)
+
+    # -- Mempool interface -------------------------------------------------
+    def lock(self) -> None:
+        self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def total_bytes(self) -> int:
+        return sum(len(t.tx) for t in self._txs.values())
+
+    def check_tx(self, tx: bytes, from_peer: str = "") -> None:
+        """Admit a tx (raises on rejection; reference CheckTx :252)."""
+        if len(tx) > self.max_tx_bytes:
+            raise ErrTxTooLarge(f"tx {len(tx)}B > {self.max_tx_bytes}B")
+        key = TxKey(tx)
+        if not self.cache.push(key):
+            raise ErrTxInCache(f"tx {key.hex()[:12]} already seen")
+        with self._lock:
+            if len(self._txs) >= self.max_txs:
+                self.cache.remove(key)
+                raise ErrMempoolFull(len(self._txs), self.max_txs)
+            resp = self.app.mempool.check_tx(tx)
+            if resp.code != 0:
+                if not self.keep_invalid:
+                    self.cache.remove(key)
+                raise ValueError(f"tx rejected by app: code {resp.code}")
+            self._txs[key] = _MempoolTx(tx, self.height, resp.gas_wanted)
+        for cb in self.on_new_tx:
+            cb(tx)
+
+    def reap_max_bytes_max_gas(self, max_bytes: int = -1, max_gas: int = -1
+                               ) -> list[bytes]:
+        """FIFO reap under byte/gas budgets (reference ReapMaxBytesMaxGas)."""
+        out, total_b, total_g = [], 0, 0
+        with self._lock:
+            for t in self._txs.values():
+                if max_bytes >= 0 and total_b + len(t.tx) > max_bytes:
+                    break
+                if max_gas >= 0 and total_g + t.gas_wanted > max_gas:
+                    break
+                out.append(t.tx)
+                total_b += len(t.tx)
+                total_g += t.gas_wanted
+        return out
+
+    def update(self, height: int, committed_txs: list[bytes],
+               results=None) -> None:
+        """Post-commit bookkeeping + recheck (reference Update :~560).
+
+        Caller must hold the mempool lock (the executor's commit path)."""
+        self.height = height
+        for i, tx in enumerate(committed_txs):
+            key = TxKey(tx)
+            code = results[i].code if results else 0
+            if code == 0:
+                self.cache.push(key)  # committed: never re-admit
+            elif not self.keep_invalid:
+                self.cache.remove(key)
+            self._txs.pop(key, None)
+        # recheck survivors against the new app state
+        for key in list(self._txs.keys()):
+            t = self._txs[key]
+            resp = self.app.mempool.check_tx(t.tx)
+            if resp.code != 0:
+                self._txs.pop(key, None)
+                if not self.keep_invalid:
+                    self.cache.remove(key)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self.cache.reset()
+
+    def txs_available(self) -> bool:
+        return bool(self._txs)
+
+
+class NopMempool:
+    """Disabled mempool (reference mempool/nop_mempool.go:111)."""
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def size(self) -> int:
+        return 0
+
+    def check_tx(self, tx: bytes, from_peer: str = "") -> None:
+        raise RuntimeError("mempool disabled")
+
+    def reap_max_bytes_max_gas(self, max_bytes: int = -1, max_gas: int = -1):
+        return []
+
+    def update(self, height, committed_txs, results=None) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def txs_available(self) -> bool:
+        return False

@@ -9,10 +9,15 @@ mod p) and d non-square, so it is valid for every curve point including the
 8-torsion components that ZIP-215 liberal decoding admits — no branch needed
 for doubling or identity inputs inside the table build.
 
-Behavior parity target: the curve math backing the reference's batch
-verifier (reference: crypto/ed25519/ed25519.go:207-240 via curve25519-voi);
-the *design* (limb layout, complete-formula ladder, windowed Shamir scan)
-is TPU-native and original.
+Round-2 ladder design (all original TPU work, no reference counterpart —
+the reference delegates to curve25519-voi assembly via
+crypto/ed25519/ed25519.go:13):
+- signed radix-16 digits in [-8, 7] (ops/scalar.py) halve table sizes;
+  negation of a cached point is two selects and one field negation.
+- tables live in "niels" form (Y+X, Y-X, 2dT [, 2Z]) so a cached-point
+  addition costs 8 muls (7 when Z=1, the constant base table).
+- doublings skip the T output except when the next op is an addition
+  (dbl_no_t: 7 muls vs 8).
 """
 
 from __future__ import annotations
@@ -41,7 +46,7 @@ def identity(batch: int):
 
 
 def add(p, q):
-    """Complete unified addition (add-2008-hwcd-3, a=-1)."""
+    """Complete unified addition (add-2008-hwcd-3, a=-1). 9 muls."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
     a = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
@@ -55,18 +60,66 @@ def add(p, q):
     return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
-def dbl(p):
-    """Dedicated doubling (dbl-2008-hwcd, a=-1); valid for all points."""
+def to_niels(p):
+    """Extended -> cached niels form (Y+X, Y-X, 2dT, 2Z). 1 mul."""
+    X, Y, Z, T = p
+    return (F.add(Y, X), F.sub(Y, X), F.mul(T, D2_C), F.add(Z, Z))
+
+
+def add_niels(p, n):
+    """Extended + niels-cached point. 8 muls."""
+    X1, Y1, Z1, T1 = p
+    ypx2, ymx2, t2d2, z22 = n
+    a = F.mul(F.sub(Y1, X1), ymx2)
+    b = F.mul(F.add(Y1, X1), ypx2)
+    c = F.mul(T1, t2d2)
+    d = F.mul(Z1, z22)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def madd(p, an):
+    """Extended + affine niels (Y+X, Y-X, 2dT with Z2=1). 7 muls."""
+    X1, Y1, Z1, T1 = p
+    ypx2, ymx2, t2d2 = an
+    a = F.mul(F.sub(Y1, X1), ymx2)
+    b = F.mul(F.add(Y1, X1), ypx2)
+    c = F.mul(T1, t2d2)
+    d = F.add(Z1, Z1)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def _dbl_efgh(p):
     X1, Y1, Z1, _ = p
     a = F.sq(X1)
     b = F.sq(Y1)
     zz = F.sq(Z1)
     c = F.add(zz, zz)
     e = F.sub(F.sub(F.sq(F.add(X1, Y1)), a), b)
-    g = F.sub(b, a)  # aA + B with a = -1
-    f = F.sub(g, c)  # hwcd: F = G - C ... sign fixed by tests vs oracle
-    h = F.neg(F.add(a, b))  # aA - B
+    g = F.sub(b, a)
+    f = F.sub(g, c)
+    h = F.neg(F.add(a, b))
+    return e, f, g, h
+
+
+def dbl(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1). 4 sq + 4 mul."""
+    e, f, g, h = _dbl_efgh(p)
     return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def dbl_no_t(p):
+    """Doubling that skips the T output (4 sq + 3 mul). The result is NOT
+    valid as input to additions — only to further doublings / freezes."""
+    e, f, g, h = _dbl_efgh(p)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), None)
 
 
 def neg(p):
@@ -124,70 +177,95 @@ def compress(p):
     return enc.at[:, 31].set(enc[:, 31] | ((x[0] & 1) << 7).astype(jnp.uint8))
 
 
-# --- Fixed-base window table: TB[i] = i * B, i in 0..15, extended affine ---
-def _host_table() -> np.ndarray:
-    out = np.zeros((16, 4, F.NLIMBS), np.int32)
-    for i in range(16):
-        pt = ref._ext_scalar_mul(i, ref.B_POINT)
-        if i == 0:
-            x, y = 0, 1
-        else:
-            x, y = ref._ext_to_affine(pt)
-        out[i, 0] = F.from_int(x)
-        out[i, 1] = F.from_int(y)
-        out[i, 2] = F.from_int(1)
-        out[i, 3] = F.from_int((x * y) % P)
+# --- Constant base table: affine niels of [i]B for i in 0..8 ---
+def _host_base_niels() -> np.ndarray:
+    out = np.zeros((9, 3, F.NLIMBS), np.int32)
+    out[0, 0] = F.from_int(1)  # identity: y+x=1, y-x=1, 2dxy=0
+    out[0, 1] = F.from_int(1)
+    for i in range(1, 9):
+        x, y = ref._ext_to_affine(ref._ext_scalar_mul(i, ref.B_POINT))
+        out[i, 0] = F.from_int((y + x) % P)
+        out[i, 1] = F.from_int((y - x) % P)
+        out[i, 2] = F.from_int((2 * ref.D * x * y) % P)
     return out
 
 
-BASE_TABLE = jnp.asarray(_host_table())  # (16, 4, 22)
+BASE_NIELS = jnp.asarray(_host_base_niels())  # (9, 3, 22)
 
 
-def _select_const(table, wins):
-    """Select rows of a constant (16, 4, 22) table per lane. wins: (B,) int32."""
-    mask = (wins[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(jnp.int32)
-    # (16,B) x (16,4,22) -> (4,22,B)
-    return jnp.einsum("tb,tcl->clb", mask, table)
-
-
-def _select_lane(table, wins):
-    """Select from a per-lane (16, 4, 22, B) table. wins: (B,) int32."""
-    mask = (wins[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(jnp.int32)
-    return (mask[:, None, None, :] * table).sum(0)
-
-
-def _lane_table(a_point):
-    """Per-lane window table [0, A, 2A, ..., 15A] as one (16, 4, 22, B) array."""
-    batch = a_point[0].shape[1]
-    pts = [identity(batch), a_point]
-    for _ in range(14):
-        pts.append(add(pts[-1], a_point))
-    return jnp.stack([jnp.stack(p) for p in pts])  # (16, 4, 22, B)
-
-
-def shamir(s_wins, k_wins, a_point):
-    """[s]B + [k]A with shared doublings (Straus/Shamir), 4-bit windows.
-
-    s_wins, k_wins: (B, 64) int32 nibble windows, little-endian (window w
-    covers bits [4w, 4w+4)). a_point: batched extended point. The ladder
-    scans windows from most to least significant under lax.scan; every
-    iteration does 4 doublings + 2 complete additions, identical across
-    lanes (no data-dependent control flow).
-    """
-    batch = s_wins.shape[0]
-    ta = _lane_table(a_point)  # (16,4,22,B)
-    xs = (
-        jnp.flip(s_wins.T, axis=0),  # (64, B), most-significant first
-        jnp.flip(k_wins.T, axis=0),
+def _signed_select_base(digits):
+    """digits: (B,) int32 in [-8, 7] -> affine niels of [digit]B."""
+    sign = digits < 0
+    idx = jnp.abs(digits)
+    mask = (idx[None, :] == jnp.arange(9, dtype=jnp.int32)[:, None]).astype(jnp.int32)
+    sel = jnp.einsum("tb,tcl->clb", mask, BASE_NIELS)  # (3, 22, B)
+    ypx, ymx, t2d = sel[0], sel[1], sel[2]
+    return (
+        F.select(sign, ymx, ypx),
+        F.select(sign, ypx, ymx),
+        F.select(sign, F.neg(t2d), t2d),
     )
+
+
+def lane_table(p):
+    """Per-lane niels table of [i]p for i in 0..8, one (9, 4, 22, B) array.
+
+    Built as a 7-step scan of P_{k+1} = P_k + P (one traced add body; an
+    unrolled dbl/add chain costs the same muls but 7x the graph)."""
+    batch = p[0].shape[1]
+    n1 = to_niels(p)
+
+    def body(pk, _):
+        nxt = add_niels(pk, n1)
+        return nxt, jnp.stack(to_niels(nxt))
+
+    _, rest = lax.scan(body, p, None, length=7)  # (7, 4, 22, B)
+    ident = (
+        jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], (F.NLIMBS, batch)),
+        jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], (F.NLIMBS, batch)),
+        jnp.zeros((F.NLIMBS, batch), jnp.int32),
+        jnp.broadcast_to(jnp.asarray(F.from_int(2))[:, None], (F.NLIMBS, batch)),
+    )
+    head = jnp.stack([jnp.stack(ident), jnp.stack(n1)])  # (2, 4, 22, B)
+    return jnp.concatenate([head, rest], axis=0)  # (9, 4, 22, B)
+
+
+def _signed_select_lane(table, digits):
+    """Select [digit]p from a (9, 4, 22, B) niels table, digit in [-8, 7]."""
+    sign = digits < 0
+    idx = jnp.abs(digits)
+    mask = (idx[None, :] == jnp.arange(9, dtype=jnp.int32)[:, None]).astype(jnp.int32)
+    sel = (mask[:, None, None, :] * table).sum(0)  # (4, 22, B)
+    ypx, ymx, t2d, z2 = sel[0], sel[1], sel[2], sel[3]
+    return (
+        F.select(sign, ymx, ypx),
+        F.select(sign, ypx, ymx),
+        F.select(sign, F.neg(t2d), t2d),
+        z2,
+    )
+
+
+def ladder(s_digits, k_digits, a_point):
+    """[s]B + [k]a_point with shared doublings, signed radix-16 digits.
+
+    s_digits, k_digits: (64, B) int32 in [-8, 7], little-endian (digit i
+    weighs 16^i) — from ops.scalar.recode_signed. a_point: batched extended
+    point. Scans digits from most to least significant under lax.scan;
+    every window does 3 T-less doublings + 1 full doubling + a base-table
+    madd + a lane-table niels add. No data-dependent control flow.
+    """
+    batch = s_digits.shape[1]
+    tbl = lane_table(a_point)
+    xs = (jnp.flip(s_digits, axis=0), jnp.flip(k_digits, axis=0))
 
     def body(r, w):
         ws, wk = w
-        r = dbl(dbl(dbl(dbl(r))))
-        sb = _select_const(BASE_TABLE, ws)
-        r = add(r, (sb[0], sb[1], sb[2], sb[3]))
-        sa = _select_lane(ta, wk)
-        r = add(r, (sa[0], sa[1], sa[2], sa[3]))
+        r = dbl_no_t(r)
+        r = dbl_no_t(r)
+        r = dbl_no_t(r)
+        r = dbl(r)
+        r = madd(r, _signed_select_base(ws))
+        r = add_niels(r, _signed_select_lane(tbl, wk))
         return r, None
 
     r0 = identity(batch)
@@ -195,14 +273,40 @@ def shamir(s_wins, k_wins, a_point):
     return r
 
 
+def fixed_base(s_digits):
+    """[s]B from signed digits (64, B) — keygen/test helper."""
+    batch = s_digits.shape[1]
+
+    def body(r, ws):
+        r = dbl_no_t(r)
+        r = dbl_no_t(r)
+        r = dbl_no_t(r)
+        r = dbl(r)
+        r = madd(r, _signed_select_base(ws))
+        return r, None
+
+    r, _ = lax.scan(body, identity(batch), jnp.flip(s_digits, axis=0))
+    return r
+
+
 def mul8(p):
-    return dbl(dbl(dbl(p)))
+    def body(xyz, _):
+        r = dbl_no_t((xyz[0], xyz[1], xyz[2], None))
+        return (r[0], r[1], r[2]), None
+
+    (x, y, z), _ = lax.scan(body, (p[0], p[1], p[2]), None, length=3)
+    return (x, y, z, None)
 
 
-def scalar_windows(scalars) -> np.ndarray:
-    """Host-side: iterable of python ints -> (B, 64) int32 nibble windows."""
-    out = np.zeros((len(scalars), 64), np.int32)
-    for i, s in enumerate(scalars):
-        for w in range(64):
-            out[i, w] = (s >> (4 * w)) & 15
+def scalar_digits(scalars) -> np.ndarray:
+    """Host-side: python ints (< 2^253) -> (64, N) int32 signed digits.
+
+    Same recoding as ops.scalar.recode_signed, for host-held scalars
+    (test/bench data generation)."""
+    half = int("8" * 64, 16)
+    out = np.zeros((64, len(scalars)), np.int32)
+    for lane, s in enumerate(scalars):
+        t = s + half
+        for i in range(64):
+            out[i, lane] = ((t >> (4 * i)) & 15) - 8
     return out

@@ -9,10 +9,10 @@ combination trick, per-lane verification is embarrassingly parallel on TPU
 lanes AND yields the per-signature validity bitmap that the commit-verify
 fallback scan needs (reference: types/validation.go:304-311) for free.
 
-Host-side responsibilities (see crypto/ed25519.py): SHA-512 of
-(R || A || M) reduced mod L -> k windows, S < L rejection, padding.
-Device inputs are fixed-shape uint8/int32 arrays; no data-dependent
-control flow — one trace per batch bucket, compiled once.
+The whole pipeline runs on device (round 2): SHA-512(R||A||M) via the
+ops/sha512 kernel, k = digest mod L via Barrett (ops/scalar), signed-digit
+recoding, ZIP-215 decompression, the shared-doubling ladder, and the
+S < L range check. The host only packs fixed-shape byte arrays.
 """
 
 from __future__ import annotations
@@ -22,25 +22,44 @@ import jax.numpy as jnp
 
 from . import curve as C
 from . import field as F
+from . import scalar as SC
+from . import sha512 as H
 
 
-def verify_batch(a_bytes, r_bytes, s_wins, k_wins, live):
-    """Batched ZIP-215 verify.
+def verify_batch(a_bytes, r_bytes, s_bytes, msg_words, two_blocks, live):
+    """Batched ZIP-215 verify, fully on device.
 
     a_bytes, r_bytes: (B, 32) uint8 — as-received A and R encodings.
-    s_wins, k_wins:   (B, 64) int32 — 4-bit little-endian windows of S and
-                      k = SHA-512(R||A||M) mod L (host-computed).
+    s_bytes:          (B, 32) uint8 — as-received S encodings.
+    msg_words:        (B, 64) uint32 — SHA-512-padded R||A||M layout from
+                      ops.sha512.pad_messages.
+    two_blocks:       (B,) bool — per-lane 2-block flag from pad_messages.
     live:             (B,) bool — padding mask (False lanes report False).
 
     Returns (B,) bool validity bitmap.
     """
+    hi, lo = H.sha512_two_blocks(msg_words, two_blocks)  # (8, B) u32, BE
+    # Digest byte i (hashlib order: big-endian words) weighs 256^i in k.
+    digest = []
+    for w in range(8):
+        for part in (hi, lo):
+            v = part[w].astype(jnp.int32)
+            digest.extend(
+                [(v >> 24) & 0xFF, (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF]
+            )
+    digest_bytes = jnp.stack(digest, axis=-1).astype(jnp.uint8)  # (B, 64)
+
+    k = SC.reduce512(digest_bytes)  # (22, B) canonical < L
+    k_digits = SC.recode_signed(k)
+    s_digits = SC.digits_from_bytes(s_bytes)
+    s_ok = SC.lt_l(s_bytes)
+
     ok_a, a_pt = C.decompress(a_bytes)
     ok_r, r_pt = C.decompress(r_bytes)
-    # [S]B + [k](-A)
-    acc = C.shamir(s_wins, k_wins, C.neg(a_pt))
+    acc = C.ladder(s_digits, k_digits, C.neg(a_pt))
     acc = C.add(acc, C.neg(r_pt))
     ok_eq = C.is_identity(C.mul8(acc))
-    return ok_a & ok_r & ok_eq & live
+    return ok_a & ok_r & ok_eq & s_ok & live
 
 
 verify_batch_jit = jax.jit(verify_batch)

@@ -131,41 +131,115 @@ def _fold_wide(t):
     """
     t = _round(t, False)
     t = _round(t, False)
-    lo = (
-        t[:NLIMBS]
-        + FOLD * t[NLIMBS : 2 * NLIMBS]
-        + jnp.pad((FOLD * FOLD) * t[2 * NLIMBS][None, :], ((0, NLIMBS - 1), (0, 0)))
+    top = (FOLD * FOLD) * t[2 * NLIMBS][None, :]
+    top_padded = jnp.concatenate(
+        [top, jnp.zeros((NLIMBS - 1, t.shape[1]), t.dtype)], axis=0
     )
+    lo = t[:NLIMBS] + FOLD * t[NLIMBS : 2 * NLIMBS] + top_padded
     return carry(lo)
 
 
-# Anti-diagonal gather matrix: (i, j) -> position i + j, flattened to
-# (484, 45). The limb product becomes ONE outer product + ONE int32
-# contraction. Measured on v5e: XLA lowers this int32 matmul onto the MXU
-# (int8 decomposition passes), making it ~40x faster per multiply than the
-# equivalent unrolled VPU shift-accumulate — keep the matmul formulation.
-# It also keeps traced graphs ~5x smaller (compile-time win).
-_CONV = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS + 1), np.int32)
-for _i in range(NLIMBS):
-    for _j in range(NLIMBS):
-        _CONV[_i * NLIMBS + _j, _i + _j] = 1
-_CONV_J = jnp.asarray(_CONV)
+_PALLAS_TILE = 512
+
+
+def _conv_rows_shifted(a, b):
+    """(22, Bt) x (22, Bt) -> (45, Bt) wide product, shifted-row form.
+
+    22 full-width multiply-accumulates (each (22, Bt)-shaped, full VPU
+    sublane utilization) instead of 484 scalar-row ops — the layout the
+    TPU vector unit wants, and a 20x smaller traced graph. Value-level
+    (jnp) variant for the CPU path.
+    """
+    t = jnp.zeros((_WIDE, a.shape[1]), jnp.int32)
+    for i in range(NLIMBS):
+        rows = a[i][None, :] * b
+        t = t + jnp.concatenate(
+            [
+                jnp.zeros((i, a.shape[1]), jnp.int32),
+                rows,
+                jnp.zeros((_WIDE - NLIMBS - i, a.shape[1]), jnp.int32),
+            ],
+            axis=0,
+        )
+    return t
+
+
+def _conv_into_scratch(a, b, t_ref):
+    """Accumulate the wide product into a (45, Bt) VMEM scratch ref
+    (Mosaic supports ref-slice accumulate; value-level update slices it
+    does not)."""
+    t_ref[...] = jnp.zeros_like(t_ref)
+    for i in range(NLIMBS):
+        t_ref[i : i + NLIMBS, :] += a[i][None, :] * b
+    return t_ref[...]
+
+
+def _mul_kernel(a_ref, b_ref, o_ref, t_ref):
+    o_ref[...] = _fold_wide(_conv_into_scratch(a_ref[...], b_ref[...], t_ref))
+
+
+def _sq_kernel(a_ref, o_ref, t_ref):
+    a = a_ref[...]
+    o_ref[...] = _fold_wide(_conv_into_scratch(a, a, t_ref))
+
+
+def _use_pallas(*arrs) -> bool:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    b = arrs[0].shape[-1]
+    return b >= 128 and (b % _PALLAS_TILE == 0 or b < _PALLAS_TILE)
+
+
+def _pallas_binop(kernel, *arrs):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = arrs[0].shape[-1]
+    tile = min(b, _PALLAS_TILE)
+    spec = pl.BlockSpec((NLIMBS, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, b), jnp.int32),
+        grid=(b // tile,),
+        in_specs=[spec] * len(arrs),
+        out_specs=spec,
+        scratch_shapes=[pltpu.VMEM((_WIDE, tile), jnp.int32)],
+    )(*arrs)
+
+
+def _bcast(a, b):
+    if a.shape[-1] != b.shape[-1]:
+        wide = max(a.shape[-1], b.shape[-1])
+        a = jnp.broadcast_to(a, (NLIMBS, wide))
+        b = jnp.broadcast_to(b, (NLIMBS, wide))
+    return a, b
 
 
 def mul(a, b):
     """Schoolbook 22x22 limb multiply. Loose inputs -> loose output.
 
-    Product limbs t[k] = sum_{i+j=k} a[i]b[j] < 2^29 (loose bound above),
-    computed as outer-product + anti-diagonal contraction (MXU-ridden, see
-    _CONV note), then folded back to 22 loose limbs.
+    On TPU this is a single Pallas kernel: the whole convolution + carry
+    chain runs in VMEM (one custom-call op in the graph — round 1's
+    einsum formulation was HBM-bound AND blew up XLA compile time).
+    Elsewhere (CPU test mesh) the same math runs as a fused jnp DAG.
+
+    Product limbs t[k] = sum_{i+j=k} a[i]b[j] < 2^29 (loose bound above).
     """
-    prod = (a[:, None, :] * b[None, :, :]).reshape(NLIMBS * NLIMBS, -1)
-    t = jnp.einsum("pk,pb->kb", _CONV_J, prod)  # (45, B)
-    return _fold_wide(t)
+    a, b = _bcast(jnp.asarray(a), jnp.asarray(b))
+    if _use_pallas(a, b):
+        return _pallas_binop(_mul_kernel, a, b)
+    return _fold_wide(_conv_rows_shifted(a, b))
 
 
 def sq(a):
-    return mul(a, a)
+    """Squaring: one-input variant of mul (halves HBM reads on TPU)."""
+    a = jnp.asarray(a)
+    if _use_pallas(a):
+        return _pallas_binop(_sq_kernel, a)
+    return _fold_wide(_conv_rows_shifted(a, a))
 
 
 def mul_small(a, c: int):

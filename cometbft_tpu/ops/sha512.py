@@ -128,14 +128,27 @@ def _maj(a, b, c):
     )
 
 
-def sha512_two_blocks(words):
-    """words: (B, 64) uint32 — two pre-padded big-endian SHA-512 blocks.
+def sha512_two_blocks(words, two_blocks=None):
+    """words: (B, 64) uint32 — up to two pre-padded big-endian SHA-512
+    blocks per lane. two_blocks: (B,) bool — lanes whose padded message
+    spans both blocks (None = all). Short lanes (standard one-block
+    padding in block 1) take the state after block 1.
 
     Returns (hi, lo): each (8, B) uint32 — the digest as 8 big-endian
     64-bit words split into halves.
+
+    The 80 rounds run under lax.scan with a rolling 16-word message window
+    in the carry (the first 16 rounds select the input word instead of the
+    schedule expansion via a where on the round index) — one traced round
+    body instead of 160 unrolled rounds keeps compile time flat.
     """
+    from jax import lax
+
     words = words.astype(jnp.uint32)
     B = words.shape[0]
+    khi = jnp.asarray(_KHI)
+    klo = jnp.asarray(_KLO)
+
     state = [
         (
             jnp.full((B,), iv >> 32, jnp.uint32),
@@ -143,52 +156,67 @@ def sha512_two_blocks(words):
         )
         for iv in _IV64
     ]
+
+    def round_body(carry, xs):
+        (a, b, c, d, e, f, g, h), whi, wlo = carry
+        t, kh, kl = xs
+        # message schedule: rolling window w[0..15]; expanded word
+        exp = _add(
+            _ssig1((whi[14], wlo[14])),
+            (whi[9], wlo[9]),
+            _ssig0((whi[1], wlo[1])),
+            (whi[0], wlo[0]),
+        )
+        use_input = t < 16
+        wt = (
+            jnp.where(use_input, whi[0], exp[0]),
+            jnp.where(use_input, wlo[0], exp[1]),
+        )
+        kt = (jnp.broadcast_to(kh, a[0].shape), jnp.broadcast_to(kl, a[0].shape))
+        t1 = _add(h, _bsig1(e), _ch(e, f, g), kt, wt)
+        t2 = _add2(_bsig0(a), _maj(a, b, c))
+        state2 = (_add2(t1, t2), a, b, c, _add2(d, t1), e, f, g)
+        whi = jnp.concatenate([whi[1:], wt[0][None]], axis=0)
+        wlo = jnp.concatenate([wlo[1:], wt[1][None]], axis=0)
+        return (state2, whi, wlo), None
+
+    states = []
     for blk in range(2):
-        w = [
-            (words[:, blk * 32 + 2 * j], words[:, blk * 32 + 2 * j + 1])
-            for j in range(16)
+        whi = jnp.stack([words[:, blk * 32 + 2 * j] for j in range(16)])
+        wlo = jnp.stack([words[:, blk * 32 + 2 * j + 1] for j in range(16)])
+        init = (tuple(state), whi, wlo)
+        xs = (jnp.arange(80, dtype=jnp.int32), khi, klo)
+        (out, _, _), _ = lax.scan(round_body, init, xs)
+        state = [_add2(s, v) for s, v in zip(state, out)]
+        states.append(state)
+    if two_blocks is None:
+        final = states[1]
+    else:
+        tb = jnp.asarray(two_blocks)
+        final = [
+            (jnp.where(tb, s2[0], s1[0]), jnp.where(tb, s2[1], s1[1]))
+            for s1, s2 in zip(states[0], states[1])
         ]
-        a, b, c, d, e, f, g, h = state
-        for t in range(80):
-            if t < 16:
-                wt = w[t]
-            else:
-                wt = _add(
-                    _ssig1(w[(t - 2) % 16]),
-                    w[(t - 7) % 16],
-                    _ssig0(w[(t - 15) % 16]),
-                    w[(t - 16) % 16],
-                )
-                w[t % 16] = wt
-            kt = (
-                jnp.full((B,), int(_KHI[t]), jnp.uint32),
-                jnp.full((B,), int(_KLO[t]), jnp.uint32),
-            )
-            t1 = _add(h, _bsig1(e), _ch(e, f, g), kt, wt)
-            t2 = _add2(_bsig0(a), _maj(a, b, c))
-            h, g, f = g, f, e
-            e = _add2(d, t1)
-            d, c, b = c, b, a
-            a = _add2(t1, t2)
-        state = [
-            _add2(s, v) for s, v in zip(state, (a, b, c, d, e, f, g, h))
-        ]
-    hi = jnp.stack([s[0] for s in state])
-    lo = jnp.stack([s[1] for s in state])
+    hi = jnp.stack([s[0] for s in final])
+    lo = jnp.stack([s[1] for s in final])
     return hi, lo
 
 
-def pad_messages(msgs: list[bytes]) -> np.ndarray:
-    """Host helper: messages -> (B, 64) uint32 big-endian padded words.
+def pad_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Host helper: messages -> ((B, 64) uint32 big-endian padded words,
+    (B,) bool two-block flags).
 
-    Vectorized for the common case of uniform-length messages (commit
-    sign-bytes share a length); falls back to a per-item loop otherwise.
+    Standard SHA-512 padding per lane: messages <= 111 bytes fit one block
+    (bit length at bytes 120..127), longer ones span two (length at bytes
+    248..255). Vectorized for the common case of uniform-length messages
+    (commit sign-bytes share a length); per-item loop otherwise.
     """
     n = len(msgs)
     buf = np.zeros((n, PADDED_BYTES), np.uint8)
-    lens = np.fromiter((len(m) for m in msgs), np.int64, n)
-    if lens.max(initial=0) > MAX_INPUT_BYTES:
+    lens = np.fromiter((len(m) for m in msgs), np.int64, n) if n else np.zeros(0, np.int64)
+    if n and lens.max(initial=0) > MAX_INPUT_BYTES:
         raise ValueError("message exceeds two SHA-512 blocks")
+    two = lens > 111
     if n and (lens == lens[0]).all():
         ln = int(lens[0])
         if ln:
@@ -199,8 +227,10 @@ def pad_messages(msgs: list[bytes]) -> np.ndarray:
             ln = len(m)
             buf[i, :ln] = np.frombuffer(m, np.uint8)
             buf[i, ln] = 0x80
-    bitlen = (lens * 8).astype(">u8")
-    buf[:, 248:256] = bitlen.view(np.uint8).reshape(n, 8)
-    return buf.reshape(n, PADDED_WORDS, 4).astype(np.uint32) @ np.array(
+    bitlen = (lens * 8).astype(">u8").view(np.uint8).reshape(n, 8)
+    buf[two, 248:256] = bitlen[two]
+    buf[~two, 120:128] = bitlen[~two]
+    words = buf.reshape(n, PADDED_WORDS, 4).astype(np.uint32) @ np.array(
         [1 << 24, 1 << 16, 1 << 8, 1], np.uint32
     )
+    return words, two

@@ -30,13 +30,14 @@ def make_mesh(devices=None, axis: str = "sig") -> Mesh:
 def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     """Build a pjit-ed batched verifier sharded over `axis`.
 
-    Inputs: a_bytes (B,32)u8, r_bytes (B,32)u8, s_wins (B,64)i32,
-    k_wins (B,64)i32, live (B,)bool; B must divide by mesh size.
+    Inputs: a_bytes (B,32)u8, r_bytes (B,32)u8, s_bytes (B,32)u8,
+    msg_words (B,64)u32, two_blocks (B,)bool, live (B,)bool; B must divide
+    by mesh size.
     Returns (all_ok: bool scalar replicated, bits: (B,) bool sharded).
     """
 
-    def local(a, r, s, k, live):
-        bits = ed25519_verify.verify_batch(a, r, s, k, live)
+    def local(a, r, s, m, tb, live):
+        bits = ed25519_verify.verify_batch(a, r, s, m, tb, live)
         # all-valid = "no live lane failed"; single psum over ICI.
         bad = jnp.sum((~bits & live).astype(jnp.int32))
         total_bad = jax.lax.psum(bad, axis)
@@ -46,7 +47,7 @@ def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec_b, spec_b, spec_b, spec_b, spec_b),
+        in_specs=(spec_b,) * 6,
         out_specs=(P(), spec_b),
         check_rep=False,
     )

@@ -1,0 +1,3 @@
+from .file_pv import FilePV, SignStep, DoubleSignError
+
+__all__ = ["FilePV", "SignStep", "DoubleSignError"]

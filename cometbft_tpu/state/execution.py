@@ -29,6 +29,7 @@ from ..types import (
     verify_commit,
 )
 from ..types.block import Consensus
+from ..types.evidence import evidence_list_hash
 from ..types.validation import CommitError
 from .types import State
 
@@ -112,6 +113,8 @@ def validate_block(
         raise BlockValidationError("wrong data_hash")
     if h.last_commit_hash != block.last_commit.hash():
         raise BlockValidationError("wrong last_commit_hash")
+    if h.evidence_hash != evidence_list_hash(block.evidence):
+        raise BlockValidationError("wrong evidence_hash")
 
     if h.height == state.initial_height:
         if block.last_commit.signatures:
@@ -139,12 +142,31 @@ def validate_block(
         raise BlockValidationError("invalid proposer address")
 
 
+def build_last_commit_info(block: Block, last_vals: ValidatorSet | None):
+    """CommitInfo for FinalizeBlock (reference internal/state/execution.go
+    buildLastCommitInfo): who signed the last commit, for incentives."""
+    from ..abci.types import CommitInfo
+
+    if block.header.height == 1 or last_vals is None:
+        return CommitInfo()
+    votes = []
+    for idx, cs in enumerate(block.last_commit.signatures):
+        val = last_vals.get_by_index(idx)
+        if val is None:
+            continue
+        votes.append((val.address, val.voting_power, not cs.is_absent()))
+    return CommitInfo(round=block.last_commit.round, votes=votes)
+
+
 class BlockExecutor:
-    def __init__(self, app_conns, state_store=None, block_store=None, backend: str = "tpu"):
+    def __init__(self, app_conns, state_store=None, block_store=None,
+                 backend: str = "tpu", mempool=None, evidence_pool=None):
         self.app = app_conns
         self.state_store = state_store
         self.block_store = block_store
         self.backend = backend
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
         self.event_handlers: list = []
 
     # --- proposal side ---
@@ -158,7 +180,15 @@ class BlockExecutor:
         block_time: Timestamp | None = None,
     ) -> Block:
         max_bytes = state.consensus_params.block.max_bytes
-        txs = self.app.consensus.prepare_proposal(txs, max_bytes)
+        ev_cap = min(state.consensus_params.evidence.max_bytes, max_bytes // 10)
+        evidence = (
+            self.evidence_pool.pending_evidence(ev_cap)
+            if self.evidence_pool is not None
+            else []
+        )
+        ev_size = sum(len(ev.wrapped()) for ev in evidence)
+        # evidence spends block budget before txs (reference MaxDataBytes)
+        txs = self.app.consensus.prepare_proposal(txs, max_bytes - ev_size)
         if height == state.initial_height:
             time = block_time or state.last_block_time
         else:
@@ -176,10 +206,13 @@ class BlockExecutor:
             consensus_hash=state.consensus_params.hash(),
             app_hash=state.app_hash,
             last_results_hash=state.last_results_hash,
-            evidence_hash=merkle.hash_from_byte_slices([]),
+            evidence_hash=evidence_list_hash(evidence),
             proposer_address=proposer_address,
         )
-        return Block(header=header, data=Data(txs), last_commit=last_commit)
+        return Block(
+            header=header, data=Data(txs), evidence=evidence,
+            last_commit=last_commit,
+        )
 
     def process_proposal(self, block: Block) -> bool:
         from ..abci.types import ProposalStatus
@@ -205,10 +238,21 @@ class BlockExecutor:
             backend=self.backend,
             last_commit_preverified=last_commit_preverified,
         )
+        if self.evidence_pool is not None and block.evidence:
+            # reject fabricated misbehavior before it reaches the app
+            # (reference internal/state/validation.go evpool.CheckEvidence)
+            self.evidence_pool.check_evidence(
+                block.evidence, state.consensus_params.evidence.max_bytes
+            )
 
         resp = self.app.consensus.finalize_block(
             FinalizeBlockRequest(
                 txs=block.data.txs,
+                decided_last_commit=build_last_commit_info(
+                    block, state.last_validators
+                ),
+                misbehavior=[m for ev in block.evidence
+                             for m in ev.to_abci_list()],
                 hash=block.hash() or b"",
                 height=block.header.height,
                 time=block.header.time,
@@ -221,7 +265,21 @@ class BlockExecutor:
 
         new_state = self._update_state(state, block_id, block, resp)
 
-        self.app.consensus.commit()
+        # Commit with the mempool locked, then update it against the new
+        # state (reference execution.go:379 Commit).
+        if self.mempool is not None:
+            self.mempool.lock()
+            try:
+                self.app.consensus.commit()
+                self.mempool.update(
+                    block.header.height, block.data.txs, resp.tx_results
+                )
+            finally:
+                self.mempool.unlock()
+        else:
+            self.app.consensus.commit()
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(new_state, block.evidence)
 
         if self.state_store is not None:
             self.state_store.save(new_state)

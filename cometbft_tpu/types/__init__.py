@@ -6,8 +6,10 @@ are bit-exact; see tests/test_canonical.py golden vectors).
 
 from .basic import BlockID, PartSetHeader, Timestamp, ZERO_TIME  # noqa: F401
 from .vote import Vote, SignedMsgType  # noqa: F401
+from .proposal import Proposal  # noqa: F401
 from .block import Block, Commit, CommitSig, Data, Header, BlockIDFlag  # noqa: F401
 from .validator_set import Validator, ValidatorSet  # noqa: F401
+from .vote_set import VoteSet  # noqa: F401
 from .validation import (  # noqa: F401
     verify_commit,
     verify_commit_light,

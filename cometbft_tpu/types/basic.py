@@ -85,6 +85,14 @@ class BlockID:
     def is_zero(self) -> bool:
         return not self.hash and self.part_set_header.is_zero()
 
+    def key(self) -> bytes:
+        """Stable map key (reference types/block.go BlockID.Key)."""
+        return (
+            self.hash
+            + self.part_set_header.total.to_bytes(4, "big")
+            + self.part_set_header.hash
+        )
+
     def encode_canonical(self) -> bytes | None:
         """CanonicalBlockID payload, or None when zero (omitted from
         CanonicalVote per reference types/canonical.go CanonicalizeBlockID)."""

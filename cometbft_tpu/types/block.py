@@ -283,7 +283,8 @@ class Block:
         return self.header.hash()
 
     def encode(self) -> bytes:
-        ev_payload = b""  # evidence encoding lands with the evidence pool
+        # EvidenceList: repeated oneof-wrapped Evidence (field 1)
+        ev_payload = b"".join(pb.f_embedded(1, ev.wrapped()) for ev in self.evidence)
         return (
             pb.f_embedded(1, self.header.encode())
             + pb.f_embedded(2, self.data.encode())
@@ -293,10 +294,16 @@ class Block:
 
     @classmethod
     def decode(cls, buf: bytes) -> "Block":
+        from .evidence import decode_evidence
+
         d = pb.fields_to_dict(buf)
+        evidence = []
+        for f, _, v in pb.parse_fields(bytes(d.get(3, b""))):
+            if f == 1:
+                evidence.append(decode_evidence(bytes(v)))
         return cls(
             header=Header.decode(bytes(d.get(1, b""))),
             data=Data.decode(bytes(d.get(2, b""))),
-            evidence=[],
+            evidence=evidence,
             last_commit=Commit.decode(bytes(d.get(4, b""))) if 4 in d else Commit(),
         )

@@ -53,11 +53,9 @@ def _fixed_base_fn(n: int):
 
     from ..ops import curve as C
 
-    zeros = jnp.zeros((n, 64), jnp.int32)
-
     @jax.jit
-    def run(wins):
-        return C.compress(C.shamir(wins, zeros, C.identity(n)))
+    def run(digs):
+        return C.compress(C.fixed_base(digs))
 
     return run
 
@@ -75,8 +73,8 @@ def _fixed_base_batch(scalars: list[int]) -> np.ndarray:
     n = len(scalars)
     b = _bucket(max(n, 1))
     padded = scalars + [1] * (b - n)
-    wins = jnp.asarray(C.scalar_windows(padded))
-    return np.asarray(_fixed_base_fn(b)(wins))[:n]
+    digs = jnp.asarray(C.scalar_digits(padded))
+    return np.asarray(_fixed_base_fn(b)(digs))[:n]
 
 
 def make_signers(n: int, seed: int = 0) -> list[ScalarSigner]:
@@ -100,6 +98,32 @@ def batch_sign(signers: list[ScalarSigner], msgs: list[bytes], seed: int = 1) ->
         s = (r + k * signer.scalar) % ref.L
         sigs.append(r_b + s.to_bytes(32, "little"))
     return sigs
+
+
+def sign_with_scalar(signer: ScalarSigner, msg: bytes) -> bytes:
+    """One host-side signature (deterministic nonce); for single-vote paths
+    (consensus state machine, privval) where device batching has nothing to
+    amortize. Standard verifiable Ed25519 output."""
+    r = (
+        int.from_bytes(
+            hashlib.sha512(b"nonce" + signer.pub_bytes + msg).digest(), "little"
+        )
+        % ref.L
+        or 1
+    )
+    r_enc = ref._encode_point(*ref._ext_to_affine(ref._ext_scalar_mul(r, ref.B_POINT)))
+    k = (
+        int.from_bytes(
+            hashlib.sha512(r_enc + signer.pub_bytes + msg).digest(), "little"
+        )
+        % ref.L
+    )
+    s = (r + k * signer.scalar) % ref.L
+    return r_enc + s.to_bytes(32, "little")
+
+
+def sign_vote(signer: ScalarSigner, vote, chain_id: str) -> None:
+    vote.signature = sign_with_scalar(signer, vote.sign_bytes(chain_id))
 
 
 def make_validator_set(

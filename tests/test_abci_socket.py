@@ -1,0 +1,130 @@
+"""ABCI socket protocol + handshake tests
+(reference abci/tests, internal/consensus/replay_test.go)."""
+
+import threading
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.socket import SocketAppConns, SocketClient, SocketServer
+from cometbft_tpu.abci.types import FinalizeBlockRequest
+from cometbft_tpu.state.handshake import Handshaker
+from cometbft_tpu.storage import BlockStore, MemKV, StateStore
+from cometbft_tpu.types import Timestamp
+from cometbft_tpu.utils.factories import make_chain
+
+
+@pytest.fixture
+def server(tmp_path):
+    app = KVStoreApp()
+    addr = f"unix://{tmp_path}/abci.sock"
+    srv = SocketServer(app, addr)
+    srv.start()
+    yield app, addr, srv
+    srv.stop()
+
+
+def test_socket_echo_info_checktx(server):
+    app, addr, _ = server
+    c = SocketClient(addr)
+    try:
+        assert c.echo(b"hello") == b"hello"
+        info = c.info()
+        assert info.last_block_height == 0
+        assert c.check_tx(b"a=1").code == 0
+        assert c.check_tx(b"malformed").code != 0
+    finally:
+        c.close()
+
+
+def test_socket_finalize_commit_query(server):
+    app, addr, _ = server
+    c = SocketClient(addr)
+    try:
+        resp = c.finalize_block(
+            FinalizeBlockRequest(
+                txs=[b"k=v", b"x=y"], height=1, time=Timestamp(1, 0),
+                hash=b"\x01" * 32,
+            )
+        )
+        assert len(resp.tx_results) == 2 and resp.app_hash
+        c.commit()
+        q = c.query("/store", b"k")
+        assert q.value == b"v"
+        assert c.info().last_block_height == 1
+    finally:
+        c.close()
+
+
+def test_socket_pipelining(server):
+    """Many concurrent callers over one pipelined client."""
+    app, addr, _ = server
+    c = SocketClient(addr)
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(20):
+                assert c.echo(b"m%d-%d" % (i, j)) == b"m%d-%d" % (i, j)
+        except Exception as e:  # noqa
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+    finally:
+        c.close()
+
+
+def test_handshake_replays_out_of_process_app(tmp_path):
+    """Build a chain in-process, then hand a FRESH out-of-process app to the
+    Handshaker: it must replay to tip with matching app hash — the
+    kill-the-app-and-restart scenario (reference replay_test.go)."""
+    store, state, genesis, signers = make_chain(
+        6, n_validators=4, chain_id="hs-chain", backend="cpu"
+    )
+    # a fresh app behind a socket (as if restarted empty)
+    app = KVStoreApp()
+    addr = f"unix://{tmp_path}/app.sock"
+    srv = SocketServer(app, addr)
+    srv.start()
+    conns = SocketAppConns(addr)
+    try:
+        ss = StateStore(MemKV())
+        hs = Handshaker(ss, store, genesis, backend="cpu")
+        out_state = hs.handshake(conns)
+        assert hs.blocks_replayed == 6
+        assert out_state.last_block_height == 6
+        assert out_state.app_hash == state.app_hash
+        # app answers queries at tip
+        q = conns.query.query("/store", b"k1-0")
+        assert q.value != b""
+    finally:
+        conns.close()
+        srv.stop()
+
+
+def test_handshake_partial_app(tmp_path):
+    """App already has some heights: only the tail is replayed into it."""
+    store, state, genesis, signers = make_chain(
+        5, n_validators=4, chain_id="hs2-chain", backend="cpu"
+    )
+    app = KVStoreApp()
+    conns = AppConns(app)
+    ss = StateStore(MemKV())
+    hs = Handshaker(ss, store, genesis, backend="cpu")
+    mid_state = hs.handshake(conns)
+    assert mid_state.last_block_height == 5
+
+    # "restart" the node with the same app (app at 5) but stale state store:
+    ss2 = StateStore(MemKV())
+    hs2 = Handshaker(ss2, store, genesis, backend="cpu")
+    with pytest.raises(Exception):
+        # state store is empty -> state height 0 < app height: reference
+        # errors on app ahead of state
+        hs2.handshake(conns)

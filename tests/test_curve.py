@@ -68,7 +68,7 @@ def _affine_of(jp):
 
 j_add = jax.jit(C.add)
 j_dbl = jax.jit(C.dbl)
-j_shamir = jax.jit(C.shamir)
+j_ladder = jax.jit(C.ladder)
 j_decompress = jax.jit(C.decompress)
 j_compress = jax.jit(C.compress)
 
@@ -138,14 +138,14 @@ def test_decompress_zip215_semantics():
             assert aff[i] == w, i
 
 
-def test_shamir_double_scalar():
+def test_ladder_double_scalar():
     n = 4
     pts = _rand_points(n)
     ss = [int.from_bytes(rng.bytes(32), "little") % ref.L for _ in range(n)]
     ks = [int.from_bytes(rng.bytes(32), "little") % ref.L for _ in range(n)]
     jp = _pack_points(pts)
-    r = j_shamir(
-        jnp.asarray(C.scalar_windows(ss)), jnp.asarray(C.scalar_windows(ks)), jp
+    r = j_ladder(
+        jnp.asarray(C.scalar_digits(ss)), jnp.asarray(C.scalar_digits(ks)), jp
     )
     want = [
         ref._ext_to_affine(
@@ -156,10 +156,23 @@ def test_shamir_double_scalar():
     assert _affine_of(r) == want
 
 
-def test_shamir_zero_scalars():
+def test_ladder_zero_scalars():
     n = 2
     pts = _rand_points(n)
     jp = _pack_points(pts)
-    z = jnp.zeros((n, 64), jnp.int32)
-    r = j_shamir(z, z, jp)
+    z = jnp.asarray(C.scalar_digits([0, 0]))
+    r = j_ladder(z, z, jp)
     assert bool(np.asarray(C.is_identity(r)).all())
+
+
+def test_fixed_base_matches_scalar_mul():
+    ss = [0, 1, 7, ref.L - 1, int.from_bytes(rng.bytes(32), "little") % ref.L]
+    r = jax.jit(C.fixed_base)(jnp.asarray(C.scalar_digits(ss)))
+    X = np.asarray(F.freeze(r[0]))
+    for i, s in enumerate(ss):
+        want = ref._ext_scalar_mul(s, ref.B_POINT)
+        if s == 0:
+            assert F.to_int(X[:, i]) == 0
+        else:
+            got = _affine_of(tuple(a[:, i:i + 1] for a in r))[0]
+            assert got == ref._ext_to_affine(want), i

@@ -1,0 +1,177 @@
+"""Mempool + evidence pool tests (reference mempool/clist_mempool_test.go,
+internal/evidence/pool_test.go)."""
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.mempool import CListMempool, TxKey
+from cometbft_tpu.mempool.mempool import ErrMempoolFull, ErrTxInCache
+from cometbft_tpu.storage import MemKV, StateStore
+from cometbft_tpu.types import Timestamp, Vote
+from cometbft_tpu.types.basic import BlockID, PartSetHeader
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+    decode_evidence,
+    evidence_list_hash,
+)
+from cometbft_tpu.types.vote import SignedMsgType
+from cometbft_tpu.utils.factories import make_signers, make_validator_set, sign_vote
+from cometbft_tpu.crypto.keys import tmhash
+from cometbft_tpu.state.types import encode_validator_set
+
+
+def _mp(**kw):
+    return CListMempool(AppConns(KVStoreApp()), **kw)
+
+
+def test_mempool_admission_and_reap():
+    mp = _mp()
+    txs = [b"k%d=v%d" % (i, i) for i in range(5)]
+    for tx in txs:
+        mp.check_tx(tx)
+    assert mp.size() == 5
+    assert mp.reap_max_bytes_max_gas() == txs  # FIFO
+    assert mp.reap_max_bytes_max_gas(max_bytes=len(txs[0]) * 2) == txs[:2]
+
+
+def test_mempool_dedup_and_invalid():
+    mp = _mp()
+    mp.check_tx(b"a=1")
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"a=1")
+    with pytest.raises(ValueError):
+        mp.check_tx(b"not-a-kv-tx")  # kvstore rejects txs without '='
+    assert mp.size() == 1
+    # rejected tx was evicted from cache -> can be retried
+    with pytest.raises(ValueError):
+        mp.check_tx(b"not-a-kv-tx")
+
+
+def test_mempool_full():
+    mp = _mp(max_txs=2)
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    with pytest.raises(ErrMempoolFull):
+        mp.check_tx(b"c=3")
+
+
+def test_mempool_update_removes_committed():
+    mp = _mp()
+    for i in range(4):
+        mp.check_tx(b"k%d=v" % i)
+    mp.lock()
+    mp.update(5, [b"k0=v", b"k2=v"])
+    mp.unlock()
+    assert mp.reap_max_bytes_max_gas() == [b"k1=v", b"k3=v"]
+    # committed txs stay cached: re-adding is rejected
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(b"k0=v")
+
+
+def _bid(tag: bytes) -> BlockID:
+    return BlockID(tmhash(tag), PartSetHeader(1, tmhash(b"p" + tag)))
+
+
+@pytest.fixture(scope="module")
+def equiv():
+    signers = make_signers(4, seed=3)
+    vals = make_validator_set(signers)
+    by_addr = {s.address(): s for s in signers}
+    s0 = by_addr[vals.validators[0].address]
+    votes = []
+    for tag in (b"one", b"two"):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT, height=5, round=0, block_id=_bid(tag),
+            timestamp=Timestamp(9, 0),
+            validator_address=vals.validators[0].address, validator_index=0,
+        )
+        sign_vote(s0, v, "ev-chain")
+        votes.append(v)
+    return vals, votes
+
+
+def test_duplicate_vote_evidence_roundtrip_and_verify(equiv):
+    vals, (va, vb) = equiv
+    ev = DuplicateVoteEvidence.from_votes(
+        va, vb, vals.validators[0].voting_power, vals.total_voting_power(),
+        Timestamp(10, 0),
+    )
+    ev.verify("ev-chain", vals)
+    back = decode_evidence(ev.wrapped())
+    assert back.hash() == ev.hash()
+    assert back.vote_a.signature == ev.vote_a.signature
+    # tampering breaks verification
+    bad = decode_evidence(ev.wrapped())
+    bad.vote_a.signature = bytes(64)
+    with pytest.raises(EvidenceError):
+        bad.verify("ev-chain", vals)
+    # same-block "equivocation" rejected
+    with pytest.raises(EvidenceError):
+        DuplicateVoteEvidence.from_votes(
+            va, va, 10, 40, Timestamp(10, 0)
+        ).verify("ev-chain", vals)
+    # ABCI conversion
+    (mb,) = ev.to_abci_list()
+    assert mb.type == 1 and mb.height == 5 and mb.validator_power == 10
+
+
+def test_evidence_in_block_hash(equiv):
+    vals, (va, vb) = equiv
+    ev = DuplicateVoteEvidence.from_votes(
+        va, vb, 10, vals.total_voting_power(), Timestamp(10, 0)
+    )
+    from cometbft_tpu.types import Block, Data, Header
+
+    h = Header(chain_id="ev-chain", height=6, validators_hash=b"\x01" * 32,
+               evidence_hash=evidence_list_hash([ev]))
+    blk = Block(header=h, data=Data([b"tx"]), evidence=[ev])
+    back = Block.decode(blk.encode())
+    assert len(back.evidence) == 1
+    assert back.evidence[0].hash() == ev.hash()
+    assert evidence_list_hash(back.evidence) == h.evidence_hash
+
+
+def test_evidence_pool_flow(equiv):
+    vals, (va, vb) = equiv
+    ss = StateStore(MemKV())
+    ss._db.set(b"SV:" + (5).to_bytes(8, "big"), encode_validator_set(vals))
+    pool = EvidencePool(state_store=ss, chain_id="ev-chain")
+    ev = DuplicateVoteEvidence.from_votes(
+        va, vb, vals.validators[0].voting_power, vals.total_voting_power(),
+        Timestamp(10, 0),
+    )
+    pool.add_evidence(ev)
+    assert pool.size() == 1
+    pending = pool.pending_evidence()
+    assert len(pending) == 1 and pending[0].hash() == ev.hash()
+
+    # committed -> removed from pending, re-add is a no-op
+    from cometbft_tpu.state.types import State
+
+    state = State(chain_id="ev-chain", initial_height=1, last_block_height=6,
+                  last_block_time=Timestamp(11, 0), validators=vals,
+                  last_validators=vals, next_validators=vals,
+                  last_height_validators_changed=1)
+    pool.update(state, [ev])
+    assert pool.size() == 0
+    pool.add_evidence(ev)
+    assert pool.size() == 0
+
+
+def test_evidence_pool_report_conflicting(equiv):
+    vals, (va, vb) = equiv
+    ss = StateStore(MemKV())
+    ss._db.set(b"SV:" + (5).to_bytes(8, "big"), encode_validator_set(vals))
+    pool = EvidencePool(state_store=ss, chain_id="ev-chain")
+    pool.report_conflicting_votes(va, vb)
+    from cometbft_tpu.state.types import State
+
+    state = State(chain_id="ev-chain", initial_height=1, last_block_height=6,
+                  last_block_time=Timestamp(11, 0), validators=vals,
+                  last_validators=vals, next_validators=vals,
+                  last_height_validators_changed=1)
+    pool.update(state, [])
+    assert pool.size() == 1

@@ -1,0 +1,91 @@
+"""FilePV double-sign protection tests (reference privval/file_test.go)."""
+
+import pytest
+
+from cometbft_tpu.crypto.keys import tmhash
+from cometbft_tpu.privval import DoubleSignError, FilePV
+from cometbft_tpu.types import BlockID, PartSetHeader, Proposal, Timestamp, Vote
+from cometbft_tpu.types.vote import SignedMsgType
+
+CHAIN = "pv-chain"
+
+
+def bid(tag: bytes) -> BlockID:
+    return BlockID(tmhash(tag), PartSetHeader(1, tmhash(b"p" + tag)))
+
+
+def mkvote(h, r, block_id, t=SignedMsgType.PRECOMMIT, ts=Timestamp(50, 0)):
+    return Vote(type=t, height=h, round=r, block_id=block_id, timestamp=ts)
+
+
+def test_sign_and_verify(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+    v = mkvote(1, 0, bid(b"a"))
+    pv.sign_vote(CHAIN, v)
+    assert pv.pub_key().verify_signature(v.sign_bytes(CHAIN), v.signature)
+
+
+def test_exact_resign_returns_same_signature(tmp_path):
+    pv = FilePV.generate(None, str(tmp_path / "state.json"))
+    v1 = mkvote(1, 0, bid(b"a"))
+    pv.sign_vote(CHAIN, v1)
+    v2 = mkvote(1, 0, bid(b"a"))
+    pv.sign_vote(CHAIN, v2)
+    assert v1.signature == v2.signature
+
+
+def test_timestamp_only_difference_reuses_signature(tmp_path):
+    pv = FilePV.generate(None, str(tmp_path / "state.json"))
+    v1 = mkvote(1, 0, bid(b"a"), ts=Timestamp(50, 0))
+    pv.sign_vote(CHAIN, v1)
+    v2 = mkvote(1, 0, bid(b"a"), ts=Timestamp(99, 5))
+    pv.sign_vote(CHAIN, v2)
+    assert v2.signature == v1.signature
+    assert v2.timestamp == Timestamp(50, 0)  # previous timestamp served
+
+
+def test_conflicting_block_refused(tmp_path):
+    pv = FilePV.generate(None, str(tmp_path / "state.json"))
+    pv.sign_vote(CHAIN, mkvote(1, 0, bid(b"a")))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(1, 0, bid(b"b")))
+
+
+def test_hrs_regression_refused(tmp_path):
+    pv = FilePV.generate(None, str(tmp_path / "state.json"))
+    pv.sign_vote(CHAIN, mkvote(5, 3, bid(b"a")))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(4, 0, bid(b"a")))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(5, 2, bid(b"a")))
+    # step regression: precommit signed, now a prevote at same h/r
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(5, 3, bid(b"a"), t=SignedMsgType.PREVOTE))
+
+
+def test_protection_survives_restart(tmp_path):
+    key, st = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(key, st)
+    pv.sign_vote(CHAIN, mkvote(7, 1, bid(b"a")))
+    pv2 = FilePV.load(key, st)
+    assert pv2.address() == pv.address()
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, mkvote(7, 1, bid(b"b")))
+    # exact re-sign still served after restart
+    v = mkvote(7, 1, bid(b"a"))
+    pv2.sign_vote(CHAIN, v)
+    assert pv.pub_key().verify_signature(v.sign_bytes(CHAIN), v.signature)
+
+
+def test_proposal_sign_and_conflict(tmp_path):
+    pv = FilePV.generate(None, str(tmp_path / "state.json"))
+    p1 = Proposal(height=2, round=0, block_id=bid(b"p"), timestamp=Timestamp(10, 0))
+    pv.sign_proposal(CHAIN, p1)
+    assert pv.pub_key().verify_signature(p1.sign_bytes(CHAIN), p1.signature)
+    # proposal then prevote at same h/r is the normal step order
+    v = mkvote(2, 0, bid(b"p"), t=SignedMsgType.PREVOTE)
+    pv.sign_vote(CHAIN, v)
+    # conflicting proposal at same h/r refused
+    p2 = Proposal(height=2, round=0, block_id=bid(b"q"), timestamp=Timestamp(10, 0))
+    with pytest.raises(DoubleSignError):
+        pv.sign_proposal(CHAIN, p2)

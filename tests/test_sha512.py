@@ -20,8 +20,8 @@ def test_sha512_matches_hashlib():
     msgs = []
     for ln in [0, 1, 3, 55, 111, 112, 127, 128, 164, 200, 239]:
         msgs.append(rng.bytes(ln))
-    words = S.pad_messages(msgs)
-    hi, lo = jax.jit(S.sha512_two_blocks)(words)
+    words, two = S.pad_messages(msgs)
+    hi, lo = jax.jit(S.sha512_two_blocks)(words, two)
     hi, lo = np.asarray(hi), np.asarray(lo)
     for i, m in enumerate(msgs):
         assert _digest_bytes(hi, lo, i) == hashlib.sha512(m).digest(), (
@@ -32,8 +32,8 @@ def test_sha512_matches_hashlib():
 def test_sha512_uniform_batch():
     rng = np.random.default_rng(7)
     msgs = [rng.bytes(122) for _ in range(64)]
-    words = S.pad_messages(msgs)
-    hi, lo = jax.jit(S.sha512_two_blocks)(words)
+    words, two = S.pad_messages(msgs)
+    hi, lo = jax.jit(S.sha512_two_blocks)(words, two)
     hi, lo = np.asarray(hi), np.asarray(lo)
     for i, m in enumerate(msgs):
         assert _digest_bytes(hi, lo, i) == hashlib.sha512(m).digest()

@@ -1,0 +1,178 @@
+"""VoteSet / BitArray tests, modeled on reference types/vote_set_test.go."""
+
+import pytest
+
+from cometbft_tpu.crypto.keys import tmhash
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+from cometbft_tpu.types.block import BlockIDFlag
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+from cometbft_tpu.types.vote import SignedMsgType, Vote
+from cometbft_tpu.types.vote_set import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteUnexpectedStep,
+    VoteSet,
+)
+from cometbft_tpu.utils.bits import BitArray
+from cometbft_tpu.utils.factories import make_signers
+
+CHAIN = "test-chain"
+N = 4
+
+
+@pytest.fixture(scope="module")
+def net():
+    signers = make_signers(N, seed=11)
+    vals = ValidatorSet(
+        [Validator.from_pub_key(s.pub_key(), 10) for s in signers],
+        increment_first=False,
+    )
+    # map sorted validator order back to signers
+    by_addr = {s.address(): s for s in signers}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    return vals, ordered
+
+
+def bid(tag: bytes) -> BlockID:
+    return BlockID(tmhash(tag), PartSetHeader(1, tmhash(b"ps" + tag)))
+
+
+def mkvote(net, idx, block_id, vtype=SignedMsgType.PRECOMMIT, height=1, round_=0):
+    vals, signers = net
+    s = signers[idx]
+    v = Vote(
+        type=vtype,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=Timestamp(100 + idx, 0),
+        validator_address=vals.validators[idx].address,
+        validator_index=idx,
+    )
+    from cometbft_tpu.utils.factories import sign_vote
+
+    sign_vote(s, v, CHAIN)
+    return v
+
+
+def test_bit_array_basics():
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    assert ba.set(3) and ba.set(9)
+    assert not ba.set(10)
+    assert ba.get(3) and not ba.get(4)
+    assert ba.num_true() == 2 and ba.true_indices() == [3, 9]
+    other = BitArray(12)
+    other.set(3)
+    other.set(11)
+    assert ba.and_(other).true_indices() == [3]
+    assert ba.or_(other).true_indices() == [3, 9, 11]
+    assert ba.sub(other).true_indices() == [9]
+    i, ok = ba.pick_random()
+    assert ok and i in (3, 9)
+    rt = BitArray.from_bytes(10, ba.to_bytes())
+    assert rt == ba
+
+
+def test_add_vote_and_maj23(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    b = bid(b"blk")
+    assert not vs.has_two_thirds_any()
+    assert vs.add_vote(mkvote(net, 0, b))
+    assert vs.add_vote(mkvote(net, 1, b))
+    assert not vs.has_two_thirds_majority()
+    # duplicate returns False without error
+    assert not vs.add_vote(mkvote(net, 1, b))
+    assert vs.add_vote(mkvote(net, 2, b))
+    assert vs.has_two_thirds_majority()
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == b
+    assert vs.bit_array().true_indices() == [0, 1, 2]
+
+
+def test_nil_votes_count_toward_any_not_block(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    nil = BlockID()
+    for i in range(3):
+        assert vs.add_vote(mkvote(net, i, nil))
+    assert vs.has_two_thirds_any()
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj is not None and maj.is_zero()  # 2/3 for nil IS a majority
+
+
+def test_wrong_step_and_address(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    with pytest.raises(ErrVoteUnexpectedStep):
+        vs.add_vote(mkvote(net, 0, bid(b"x"), vtype=SignedMsgType.PREVOTE))
+    v = mkvote(net, 0, bid(b"x"))
+    v.validator_index = 1  # address of 0, slot of 1
+    with pytest.raises(ErrVoteInvalidValidatorAddress):
+        vs.add_vote(v)
+
+
+def test_bad_signature(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    v = mkvote(net, 0, bid(b"x"))
+    v.signature = bytes(64)
+    with pytest.raises(ErrVoteInvalidSignature):
+        vs.add_vote(v)
+
+
+def test_conflicting_votes_and_peer_maj23(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    a, b = bid(b"a"), bid(b"b")
+    assert vs.add_vote(mkvote(net, 0, a))
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        vs.add_vote(mkvote(net, 0, b))
+    assert ei.value.vote_a.block_id == a and ei.value.vote_b.block_id == b
+    # after a peer claims maj23 for b, the conflicting vote is tracked AND
+    # the equivocation still surfaces (reference: added=true with error)
+    vs.set_peer_maj23("peer1", b)
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        vs.add_vote(mkvote(net, 0, b))
+    assert ei.value.added
+    # canonical vote for validator 0 is still for a
+    assert vs.get_by_index(0).block_id == a
+    assert vs.bit_array_by_block_id(b).true_indices() == [0]
+    # b reaches 2/3 via validators 1,2 -> promoted to canonical
+    vs.add_vote(mkvote(net, 1, b))
+    vs.add_vote(mkvote(net, 2, b))
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == b
+    assert vs.get_by_index(0).block_id == b
+    # a post-maj23 conflicting vote FOR the maj23 block replaces the slot
+    vs2 = VoteSet(CHAIN, 1, 0, SignedMsgType.PRECOMMIT, vals)
+    vs2.set_peer_maj23("p", b)
+    vs2.add_vote(mkvote(net, 3, a))
+    for i in range(3):
+        vs2.add_vote(mkvote(net, i, b))
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        vs2.add_vote(mkvote(net, 3, b))
+    assert ei.value.added
+    assert vs2.get_by_index(3).block_id == b
+    commit = vs2.make_commit()
+    assert all(cs.is_commit() for cs in commit.signatures)
+
+
+def test_make_commit(net):
+    vals, _ = net
+    vs = VoteSet(CHAIN, 3, 1, SignedMsgType.PRECOMMIT, vals)
+    b = bid(b"commit-me")
+    for i in range(3):
+        vs.add_vote(mkvote(net, i, b, height=3, round_=1))
+    # validator 3 voted nil
+    vs.add_vote(mkvote(net, 3, BlockID(), height=3, round_=1))
+    commit = vs.make_commit()
+    assert commit.height == 3 and commit.round == 1 and commit.block_id == b
+    flags = [cs.block_id_flag for cs in commit.signatures]
+    assert flags == [BlockIDFlag.COMMIT] * 3 + [BlockIDFlag.NIL]
+    # the commit verifies against the validator set
+    from cometbft_tpu.types.validation import verify_commit
+
+    verify_commit(CHAIN, vals, b, 3, commit, backend="cpu")

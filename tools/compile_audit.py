@@ -1,0 +1,49 @@
+"""Per-stage TPU compile-time audit of the verify pipeline."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import curve as C, field as F, scalar as SC, sha512 as H
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+rng = np.random.default_rng(0)
+words = jnp.asarray(rng.integers(0, 2**32, (B, 64), dtype=np.uint32))
+db = jnp.asarray(rng.integers(0, 256, (B, 64), dtype=np.uint8))
+dig = jnp.asarray(rng.integers(-8, 8, (64, B), dtype=np.int32))
+enc = np.zeros((B, 32), np.uint8)
+enc[:, 0] = 1  # y=1: identity, valid encoding
+encj = jnp.asarray(enc)
+
+
+def t(name, f, *args):
+    t0 = time.perf_counter()
+    lowered = jax.jit(f).lower(*args)
+    tl = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    comp = lowered.compile()
+    tc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(comp(*args))
+    tr = time.perf_counter() - t0
+    print(f"{name}: lower {tl:.1f}s compile {tc:.1f}s run {tr*1e3:.1f}ms",
+          flush=True)
+
+
+t("sha512", H.sha512_two_blocks, words)
+t("reduce512+recode", lambda d: SC.recode_signed(SC.reduce512(d)), db)
+t("decompress", C.decompress, encj)
+t("lane_table", lambda e: jnp.sum(C.lane_table(C.decompress(e)[1])), encj)
+t("ladder", lambda d, e: C.ladder(d, d, C.decompress(e)[1])[0], dig, encj)
+from cometbft_tpu.ops.ed25519_verify import verify_batch
+
+live = jnp.ones((B,), bool)
+two = jnp.ones((B,), bool)
+sb = jnp.asarray(rng.integers(0, 128, (B, 32), dtype=np.uint8))
+t("verify_full", verify_batch, encj, encj, sb, words, two, live)

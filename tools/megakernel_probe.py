@@ -1,0 +1,95 @@
+"""Probe: K field-squarings inside ONE pallas kernel via fori_loop.
+
+Validates Mosaic support (fori_loop + scratch-ref conv + carries) and
+measures marginal per-sq cost, vs K separate pallas sq calls.
+"""
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cometbft_tpu.ops import field as F
+
+NL = F.NLIMBS
+WIDE = F._WIDE
+
+
+def _sq_value(a, t_ref):
+    t_ref[...] = jnp.zeros_like(t_ref)
+    for i in range(NL):
+        t_ref[i : i + NL, :] += a[i][None, :] * a
+    return F._fold_wide(t_ref[...])
+
+
+def make_kernel(k):
+    def kernel(a_ref, o_ref, t_ref):
+        def body(_, c):
+            return _sq_value(c, t_ref)
+
+        o_ref[...] = lax.fori_loop(0, k, body, a_ref[...])
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("k", "tile"))
+def sqn_mega(a, k, tile=512):
+    b = a.shape[1]
+    spec = pl.BlockSpec((NL, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        make_kernel(k),
+        out_shape=jax.ShapeDtypeStruct((NL, b), jnp.int32),
+        grid=(b // tile,),
+        in_specs=[spec],
+        out_specs=spec,
+        scratch_shapes=[pltpu.VMEM((WIDE, tile), jnp.int32)],
+    )(a)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def sqn_calls(a, k):
+    def body(c, _):
+        return F.sq(c), None
+
+    return lax.scan(body, a, None, length=k)[0]
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    tile = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+
+    # correctness vs value path
+    r_mega = np.asarray(sqn_mega(a, 5, tile))
+    r_call = np.asarray(sqn_calls(a, 5))
+    for lane in range(0, B, B // 7):
+        assert F.to_int(r_mega[:, lane]) % F.P_INT == F.to_int(r_call[:, lane]) % F.P_INT, lane
+    print("correct", flush=True)
+
+    for name, fn in (("mega", lambda k: sqn_mega(a, k, tile)),
+                     ("calls", lambda k: sqn_calls(a, k))):
+        ts = {}
+        for k in (8, 264):
+            jax.block_until_ready(fn(k))
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = fn(k)
+            jax.block_until_ready(r)
+            ts[k] = (time.perf_counter() - t0) / 5
+        per = (ts[264] - ts[8]) / 256
+        print(f"{name} B={B} tile={tile}: {per*1e6:6.1f}us/sq -> "
+              f"{B/per/1e9:6.2f} Gsq/s (t264={ts[264]*1e3:.1f}ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

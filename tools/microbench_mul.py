@@ -1,0 +1,106 @@
+"""Microbenchmark: formulations of the batched GF(p) limb multiply on TPU.
+
+Decides the round-2 kernel redesign. Candidates:
+  A. status quo: int32 outer product + int32 einsum (45,484)@(484,B)
+  B. int8 digit split: products split into 8-bit digits, contracted with the
+     0/1 conv matrix via int8xint8->int32 dot (native MXU path on v5e)
+  C. bf16 digit split: digits <256 are bf16-exact; conv matrix bf16; f32 accum
+  D. f32 everything: products <2^24 are f32-exact; f32 matmul
+Each timed at batch sizes relevant to 10k-sig commits.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NL = 22
+WIDE = 45
+CONV = np.zeros((NL * NL, WIDE), np.int32)
+for i in range(NL):
+    for j in range(NL):
+        CONV[i * NL + j, i + j] = 1
+
+CONV_I32 = jnp.asarray(CONV)
+CONV_I8 = jnp.asarray(CONV.astype(np.int8))
+CONV_BF16 = jnp.asarray(CONV.astype(np.float32), dtype=jnp.bfloat16)
+CONV_F32 = jnp.asarray(CONV.astype(np.float32))
+
+
+def outer(a, b):
+    return (a[:, None, :] * b[None, :, :]).reshape(NL * NL, -1)
+
+
+@jax.jit
+def mul_a(a, b):
+    prod = outer(a, b)
+    return jnp.einsum("pk,pb->kb", CONV_I32, prod)
+
+
+@jax.jit
+def mul_b(a, b):
+    prod = outer(a, b)  # < 2^24
+    d0 = (prod & 0xFF).astype(jnp.int8)
+    d1 = ((prod >> 8) & 0xFF).astype(jnp.int8)
+    d2 = (prod >> 16).astype(jnp.int8)
+    def c(d):
+        return jax.lax.dot_general(
+            CONV_I8, d, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    return c(d0) + (c(d1) << 8) + (c(d2) << 16)
+
+
+@jax.jit
+def mul_c(a, b):
+    prod = outer(a, b)
+    d0 = (prod & 0xFF).astype(jnp.bfloat16)
+    d1 = ((prod >> 8) & 0xFF).astype(jnp.bfloat16)
+    d2 = (prod >> 16).astype(jnp.bfloat16)
+    def c(d):
+        return jax.lax.dot_general(
+            CONV_BF16, d, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return (c(d0).astype(jnp.int32) + (c(d1).astype(jnp.int32) << 8)
+            + (c(d2).astype(jnp.int32) << 16))
+
+
+@jax.jit
+def mul_d(a, b):
+    prod = outer(a, b).astype(jnp.float32)  # exact: < 2^24
+    t = jax.lax.dot_general(CONV_F32, prod, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    # sums of 22 terms < 2^24 -> < 2^28.5: NOT f32-exact; measurement only
+    return t.astype(jnp.int32)
+
+
+# int16 limbs variant: 16 limbs of 16 bits? products 32 bits - overflow. skip.
+
+def bench(fn, B, iters=30):
+    key = np.random.default_rng(0)
+    a = jnp.asarray(key.integers(0, 4096, (NL, B), dtype=np.int32))
+    b = jnp.asarray(key.integers(0, 4096, (NL, B), dtype=np.int32))
+    r = fn(a, b)
+    r.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(a, b)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    print("devices:", jax.devices())
+    for B in (4096, 65536, 524288):
+        row = {"B": B}
+        for name, fn in [("A_int32", mul_a), ("B_int8", mul_b),
+                         ("C_bf16", mul_c), ("D_f32", mul_d)]:
+            try:
+                dt = bench(fn, B)
+                row[name] = f"{dt*1e6:8.1f}us  {B/dt/1e9:6.2f} Gmul/s"
+            except Exception as e:  # noqa
+                row[name] = f"FAIL {type(e).__name__}"
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

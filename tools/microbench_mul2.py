@@ -1,0 +1,94 @@
+"""Marginal per-mul cost: chain K muls inside one jit via lax.scan."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+NL = 22
+WIDE = 45
+CONV = np.zeros((NL * NL, WIDE), np.int32)
+for i in range(NL):
+    for j in range(NL):
+        CONV[i * NL + j, i + j] = 1
+CONV_I8 = jnp.asarray(CONV.astype(np.int8))
+CONV_I32 = jnp.asarray(CONV)
+MASK = 4095
+
+
+def carry(x):
+    for _ in range(3):
+        m = x & MASK
+        hi = x >> 12
+        up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+        top = jnp.concatenate([9728 * hi[-1:], jnp.zeros_like(hi[1:])], axis=0)
+        x = m + up + top
+    return x
+
+
+def fold_wide(t):
+    m = t & MASK
+    hi = t >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t = m + up
+    m = t & MASK
+    hi = t >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t = m + up
+    lo = (t[:NL] + 9728 * t[NL:2 * NL]
+          + jnp.pad((9728 * 9728) * t[2 * NL][None, :], ((0, NL - 1), (0, 0))))
+    return carry(lo)
+
+
+def mul_i32(a, b):
+    prod = (a[:, None, :] * b[None, :, :]).reshape(NL * NL, -1)
+    t = jnp.einsum("pk,pb->kb", CONV_I32, prod)
+    return fold_wide(t)
+
+
+def mul_i8(a, b):
+    prod = (a[:, None, :] * b[None, :, :]).reshape(NL * NL, -1)
+    d0 = (prod & 0xFF).astype(jnp.int8)
+    d1 = ((prod >> 8) & 0xFF).astype(jnp.int8)
+    d2 = (prod >> 16).astype(jnp.int8)
+    def c(d):
+        return jax.lax.dot_general(CONV_I8, d, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+    t = c(d0) + (c(d1) << 8) + (c(d2) << 16)
+    return fold_wide(t)
+
+
+@partial(jax.jit, static_argnames=("kind", "k"))
+def chain(a, b, kind, k):
+    f = mul_i32 if kind == "i32" else mul_i8
+    def body(c, _):
+        return f(c, b), None
+    out, _ = jax.lax.scan(body, a, None, length=k)
+    return out
+
+
+def bench(kind, B, k, iters=10):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    r = chain(a, b, kind, k)
+    r.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = chain(a, b, kind, k)
+    r.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    for B in (8192, 65536):
+        for kind in ("i32", "i8"):
+            t1 = bench(kind, B, 8)
+            t2 = bench(kind, B, 136)
+            per_mul = (t2 - t1) / 128
+            print(f"B={B:6d} {kind}: marginal {per_mul*1e6:7.1f}us/mul "
+                  f"-> {B/per_mul/1e9:7.3f} Gmul/s  (t8={t1*1e3:.2f}ms t136={t2*1e3:.2f}ms)")
+
+
+if __name__ == "__main__":
+    main()

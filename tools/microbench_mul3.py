@@ -1,0 +1,145 @@
+"""Approach B: field mul as one fusible elementwise expression (no einsum).
+
+Compares: current einsum mul vs direct-conv mul vs direct-conv + Karatsuba,
+plus a dedicated squaring. Marginal cost via dependent scan chains.
+"""
+import time
+from functools import partial
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NL = 22
+MASK = 4095
+FOLD = 9728
+
+
+def carry3(x):
+    for _ in range(3):
+        m = x & MASK
+        hi = x >> 12
+        up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+        top = jnp.concatenate([FOLD * hi[-1:], jnp.zeros_like(hi[1:])], axis=0)
+        x = m + up + top
+    return x
+
+
+def fold_wide(rows):
+    """rows: list of 43 (B,) wide-limb vectors -> loose (22,B)."""
+    z = jnp.zeros_like(rows[0])
+    t = jnp.stack(rows + [z, z])  # (45,B); rows 43-44 absorb carries
+    m = t & MASK
+    hi = t >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t = m + up
+    m = t & MASK
+    hi = t >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t = m + up
+    lo = (t[:NL] + FOLD * t[NL:2 * NL]
+          + jnp.pad((FOLD * FOLD) * t[2 * NL][None, :], ((0, NL - 1), (0, 0))))
+    return carry3(lo)
+
+
+def mul_direct(a, b):
+    rows = []
+    for k in range(2 * NL - 1):
+        terms = [a[i] * b[k - i] for i in range(max(0, k - NL + 1), min(NL, k + 1))]
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t
+        rows.append(s)
+    return fold_wide(rows)
+
+
+def sq_direct(a):
+    rows = []
+    for k in range(2 * NL - 1):
+        lo = max(0, k - NL + 1)
+        hi = min(NL, k + 1)
+        terms = []
+        for i in range(lo, hi):
+            j = k - i
+            if i < j:
+                terms.append(2 * (a[i] * a[j]))
+            elif i == j:
+                terms.append(a[i] * a[i])
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t
+        rows.append(s)
+    return fold_wide(rows)
+
+
+CONV = np.zeros((NL * NL, 2 * NL + 1), np.int32)
+for i in range(NL):
+    for j in range(NL):
+        CONV[i * NL + j, i + j] = 1
+CONV_J = jnp.asarray(CONV)
+
+
+def mul_einsum(a, b):
+    prod = (a[:, None, :] * b[None, :, :]).reshape(NL * NL, -1)
+    t = jnp.einsum("pk,pb->kb", CONV_J, prod)
+    t2 = t[:2 * NL - 1] + FOLD * FOLD * jnp.pad(t[2 * NL:], ((0, 2 * NL - 2), (0, 0)))
+    m = t2 & MASK
+    hi = t2 >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t2 = m + up
+    m = t2 & MASK
+    hi = t2 >> 12
+    up = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t2 = m + up
+    lo = t2[:NL] + FOLD * jnp.pad(t2[NL:], ((0, 1), (0, 0)))
+    return carry3(lo)
+
+
+@partial(jax.jit, static_argnames=("kind", "k"))
+def chain(a, b, kind, k):
+    f = {"direct": mul_direct, "einsum": mul_einsum,
+         "sq": lambda x, y: sq_direct(x)}[kind]
+    def body(c, _):
+        return f(c, b), None
+    out, _ = jax.lax.scan(body, a, None, length=k)
+    return out
+
+
+def bench(kind, B, iters=5):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    t = {}
+    for k in (8, 264):
+        r = chain(a, b, kind, k)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = chain(a, b, kind, k)
+        jax.block_until_ready(r)
+        t[k] = (time.perf_counter() - t0) / iters
+    per = (t[264] - t[8]) / 256
+    print(f"B={B:6d} {kind:7s}: {per*1e6:7.2f}us/mul -> {B/per/1e9:7.3f} Gmul/s"
+          f"  (t8={t[8]*1e3:.2f}ms t264={t[264]*1e3:.2f}ms)", flush=True)
+
+
+def check():
+    rng = np.random.default_rng(1)
+    B = 8
+    a = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 4096, (NL, B), dtype=np.int32))
+    P = 2**255 - 19
+    def to_int(limbs, lane):
+        return sum(int(v) << (12 * i) for i, v in enumerate(np.asarray(limbs)[:, lane]))
+    for lane in range(3):
+        ai, bi = to_int(a, lane), to_int(b, lane)
+        assert to_int(mul_direct(a, b), lane) % P == (ai * bi) % P
+        assert to_int(sq_direct(a), lane) % P == (ai * ai) % P
+        # einsum variant here is timing-only (field.py has the correct fold)
+    print("correctness OK", flush=True)
+
+
+if __name__ == "__main__":
+    check()
+    for B in (16384, 131072):
+        for kind in ("einsum", "direct", "sq"):
+            bench(kind, B)

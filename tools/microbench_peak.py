@@ -1,0 +1,57 @@
+"""Peak throughput sanity: big matmuls + elementwise ops on this chip."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timeit(f, *xs, iters=20):
+    r = f(*xs); r.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(*xs)
+    r.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    N = 4096
+    rng = np.random.default_rng(0)
+    a16 = jnp.asarray(rng.standard_normal((N, N)), dtype=jnp.bfloat16)
+    b16 = jnp.asarray(rng.standard_normal((N, N)), dtype=jnp.bfloat16)
+    mm16 = jax.jit(lambda a, b: a @ b)
+    dt = timeit(mm16, a16, b16)
+    print(f"bf16 {N}^3 matmul: {dt*1e3:.2f}ms -> {2*N**3/dt/1e12:.1f} TFLOPS")
+
+    a8 = jnp.asarray(rng.integers(-100, 100, (N, N), dtype=np.int8))
+    b8 = jnp.asarray(rng.integers(-100, 100, (N, N), dtype=np.int8))
+    mm8 = jax.jit(lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32))
+    dt = timeit(mm8, a8, b8)
+    print(f"int8 {N}^3 matmul: {dt*1e3:.2f}ms -> {2*N**3/dt/1e12:.1f} TOPS")
+
+    M = 1 << 26
+    x = jnp.asarray(rng.integers(0, 1 << 20, (M,), dtype=np.int32))
+    ew = jax.jit(lambda x: ((x * x) >> 12) & 4095)
+    dt = timeit(ew, x)
+    print(f"int32 elementwise mul+shift+and ({M} elems): {dt*1e3:.2f}ms -> "
+          f"{3*M/dt/1e12:.2f} Tops, bw {2*4*M/dt/1e9:.0f} GB/s")
+
+    f = jnp.asarray(rng.standard_normal((M,)), dtype=jnp.float32)
+    ewf = jax.jit(lambda x: x * x + x)
+    dt = timeit(ewf, f)
+    print(f"f32 elementwise fma ({M} elems): {dt*1e3:.2f}ms -> {2*M/dt/1e12:.2f} TFLOPS, bw {2*4*M/dt/1e9:.0f} GB/s")
+
+    # narrow-M matmul like our conv contraction
+    for (Mm, K) in ((45, 484), (128, 484), (64, 1024)):
+        B = 1 << 17
+        c = jnp.asarray(rng.integers(0, 2, (Mm, K), dtype=np.int8))
+        d = jnp.asarray(rng.integers(-128, 127, (K, B), dtype=np.int8))
+        mm = jax.jit(lambda c, d: jax.lax.dot_general(
+            c, d, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32))
+        dt = timeit(mm, c, d)
+        print(f"int8 ({Mm},{K})@({K},{B}): {dt*1e3:.2f}ms -> {2*Mm*K*B/dt/1e12:.2f} TOPS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Peak throughput, robust: vary inputs per iter, force scalar readback."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timeit(f, make_args, iters=8):
+    args = [make_args(i) for i in range(iters + 1)]
+    r = f(*args[0])
+    _ = np.asarray(jax.tree_util.tree_leaves(r)[0][..., :1])  # force
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(1, iters + 1):
+        outs.append(f(*args[i]))
+    # force readback of a scalar from every output
+    s = 0
+    for o in outs:
+        s += int(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    return dt, s
+
+
+def main():
+    rng = np.random.default_rng(0)
+    N = 4096
+
+    def mk16(i):
+        a = jnp.asarray(rng.standard_normal((N, N)), dtype=jnp.bfloat16)
+        b = jnp.asarray(rng.standard_normal((N, N)), dtype=jnp.bfloat16)
+        return a, b
+    mm16 = jax.jit(lambda a, b: (a @ b).astype(jnp.float32))
+    dt, _ = timeit(mm16, mk16)
+    print(f"bf16 {N}^3 matmul: {dt*1e3:.2f}ms -> {2*N**3/dt/1e12:.1f} TFLOPS")
+
+    def mk8(i):
+        a = jnp.asarray(rng.integers(-100, 100, (N, N), dtype=np.int8))
+        b = jnp.asarray(rng.integers(-100, 100, (N, N), dtype=np.int8))
+        return a, b
+    mm8 = jax.jit(lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32))
+    dt, _ = timeit(mm8, mk8)
+    print(f"int8 {N}^3 matmul: {dt*1e3:.2f}ms -> {2*N**3/dt/1e12:.1f} TOPS")
+
+    M = 1 << 26
+    def mki(i):
+        return (jnp.asarray(rng.integers(0, 1 << 20, (M,), dtype=np.int32)),)
+    ew = jax.jit(lambda x: ((x * x) >> 12) & 4095)
+    dt, _ = timeit(ew, mki)
+    print(f"int32 ew ({M}): {dt*1e3:.2f}ms -> {3*M/dt/1e12:.2f} Tops bw {8*M/dt/1e9:.0f} GB/s")
+
+    # chain of 64 elementwise ops entirely on-device, one input
+    ch = jax.jit(lambda x: jax.lax.fori_loop(
+        0, 64, lambda i, v: ((v * v) >> 7) & 0xFFFFF ^ v, x))
+    dt, _ = timeit(ch, mki)
+    print(f"int32 ew chain x64x3ops ({M}): {dt*1e3:.2f}ms -> {64*4*M/dt/1e12:.2f} Tops")
+
+
+if __name__ == "__main__":
+    main()

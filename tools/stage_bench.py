@@ -1,0 +1,45 @@
+"""Steady-state runtime of each verify stage on TPU."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import curve as C, field as F, scalar as SC, sha512 as H
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+rng = np.random.default_rng(0)
+words = jnp.asarray(rng.integers(0, 2**32, (B, 64), dtype=np.uint32))
+db = jnp.asarray(rng.integers(0, 256, (B, 64), dtype=np.uint8))
+dig = jnp.asarray(rng.integers(-8, 8, (64, B), dtype=np.int32))
+enc = np.zeros((B, 32), np.uint8)
+enc[:, 0] = 1
+encj = jnp.asarray(enc)
+two = jnp.ones((B,), bool)
+
+
+def bench(name, f, *args, iters=5):
+    g = jax.jit(f)
+    jax.block_until_ready(g(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = g(*args)
+    jax.block_until_ready(r)
+    print(f"{name}: {(time.perf_counter()-t0)/iters*1e3:8.1f}ms", flush=True)
+
+
+bench("sha512", H.sha512_two_blocks, words, two)
+bench("reduce512", SC.reduce512, db)
+bench("recode", SC.recode_signed, F.from_bytes_le(db[:, :32]))
+bench("lt_l", SC.lt_l, db[:, :32])
+bench("decompress", C.decompress, encj)
+bench("lane_table", lambda e: jnp.sum(C.lane_table(C.decompress(e)[1])), encj)
+bench("ladder", lambda d, e: C.ladder(d, d, C.decompress(e)[1])[0], dig, encj)
+bench("pow2523", F.pow2523, F.from_bytes_le(db[:, :32]))
+bench("freeze", F.freeze, F.from_bytes_le(db[:, :32]))
+bench("mul8+ident", lambda e: C.is_identity(C.mul8(C.decompress(e)[1])), encj)

@@ -1,0 +1,79 @@
+"""TPU probe: compile time + runtime of the verify kernel at a given batch.
+
+Usage: python tools/tpu_probe.py [batch] [what]
+what: mul | ladder | verify (default verify)
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    what = sys.argv[2] if len(sys.argv) > 2 else "verify"
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend(), flush=True)
+
+    if what == "mul":
+        from cometbft_tpu.ops import field as F
+
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.integers(0, 4096, (F.NLIMBS, b), dtype=np.int32))
+        bb = jnp.asarray(rng.integers(0, 4096, (F.NLIMBS, b), dtype=np.int32))
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def chain(a, bb, k):
+            def body(c, _):
+                return F.mul(c, bb), None
+            out, _ = jax.lax.scan(body, a, None, length=k)
+            return out
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(a, bb, 8))
+        print(f"compile+run k=8: {time.perf_counter()-t0:.2f}s", flush=True)
+        for k in (8, 264):
+            jax.block_until_ready(chain(a, bb, k))
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = chain(a, bb, k)
+            jax.block_until_ready(r)
+            print(f"k={k}: {(time.perf_counter()-t0)/5*1e3:.2f}ms", flush=True)
+        return
+
+    from cometbft_tpu.crypto.testgen import generate_signed_batch
+    from cometbft_tpu.crypto.ed25519 import Ed25519BatchVerifier, Ed25519PubKey
+
+    t0 = time.perf_counter()
+    items = generate_signed_batch(min(b, 256), seed=0, msg_len=100)
+    print(f"testgen: {time.perf_counter()-t0:.1f}s", flush=True)
+    items = [items[i % len(items)] for i in range(b)]
+
+    def run():
+        bv = Ed25519BatchVerifier(backend="tpu")
+        for pub, msg, sig in items:
+            bv.add(Ed25519PubKey(pub), msg, sig)
+        ok, bits = bv.verify()
+        return ok, bits
+
+    t0 = time.perf_counter()
+    ok, bits = run()
+    print(f"first call (compile+run): {time.perf_counter()-t0:.1f}s ok={ok}", flush=True)
+    assert ok, f"batch must verify ({sum(bits)}/{len(bits)})"
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        run()
+    dt = (time.perf_counter() - t0) / iters
+    print(f"steady: {dt*1e3:.1f}ms -> {b/dt:,.0f} sigs/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
