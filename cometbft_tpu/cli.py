@@ -1,0 +1,238 @@
+"""Command-line interface (reference cmd/cometbft/commands/*).
+
+Subcommands: init, start, testnet, show-node-id, show-validator,
+gen-node-key, gen-validator, reset-all, version, inspect-lite.
+Run via `python -m cometbft_tpu.cli <cmd> [--home DIR]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+VERSION = "0.2.0"  # round-2 line
+
+
+def _cfg_paths(home: str):
+    return {
+        "config": os.path.join(home, "config"),
+        "data": os.path.join(home, "data"),
+        "config_file": os.path.join(home, "config", "config.toml"),
+        "genesis": os.path.join(home, "config", "genesis.json"),
+        "pv_key": os.path.join(home, "config", "priv_validator_key.json"),
+        "pv_state": os.path.join(home, "data", "priv_validator_state.json"),
+        "node_key": os.path.join(home, "config", "node_key.json"),
+    }
+
+
+def cmd_init(args) -> int:
+    """reference commands/init.go: config + genesis + keys."""
+    from .config import Config
+    from .privval import FilePV
+    from .types import Timestamp
+    from .types.genesis import GenesisDoc, GenesisValidator
+
+    p = _cfg_paths(args.home)
+    os.makedirs(p["config"], exist_ok=True)
+    os.makedirs(p["data"], exist_ok=True)
+    cfg = Config()
+    cfg.base.home = args.home
+    cfg.base.chain_id = args.chain_id
+    cfg.save(p["config_file"])
+    pv = FilePV.generate(p["pv_key"], p["pv_state"])
+    if not os.path.exists(p["genesis"]):
+        gd = GenesisDoc(
+            chain_id=args.chain_id,
+            genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+            validators=[GenesisValidator(pv.pub_key().bytes(), 10, "validator")],
+        )
+        gd.save(p["genesis"])
+    from .p2p import NodeKey
+
+    NodeKey.load_or_generate(p["node_key"])
+    print(f"initialized node home at {args.home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """reference commands/run_node.go."""
+    from .abci.kvstore import KVStoreApp
+    from .config import Config
+    from .node import Node
+
+    p = _cfg_paths(args.home)
+    cfg = Config.load(p["config_file"])
+    cfg.base.home = args.home
+    app = KVStoreApp() if cfg.base.abci == "local" else None
+    node = Node(cfg, app=app)
+    node.start()
+    print(f"node started: p2p {node.listen_addr}, rpc {getattr(node, 'rpc_addr', None)}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """reference commands/testnet.go: N validator homes + shared genesis."""
+    from .config import Config
+    from .privval import FilePV
+    from .types import Timestamp
+    from .types.genesis import GenesisDoc, GenesisValidator
+
+    pvs = []
+    homes = []
+    for i in range(args.v):
+        home = os.path.join(args.output, f"node{i}")
+        p = _cfg_paths(home)
+        os.makedirs(p["config"], exist_ok=True)
+        os.makedirs(p["data"], exist_ok=True)
+        pvs.append(FilePV.generate(p["pv_key"], p["pv_state"]))
+        homes.append(home)
+    gd = GenesisDoc(
+        chain_id=args.chain_id,
+        genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    base_p2p = args.starting_port
+    for i, home in enumerate(homes):
+        p = _cfg_paths(home)
+        cfg = Config()
+        cfg.base.home = home
+        cfg.base.chain_id = args.chain_id
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"127.0.0.1:{base_p2p + 2 * j}" for j in range(args.v) if j != i
+        )
+        cfg.save(p["config_file"])
+        gd.save(p["genesis"])
+    print(f"generated {args.v} validator homes under {args.output}")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .p2p import NodeKey
+
+    p = _cfg_paths(args.home)
+    print(NodeKey.load_or_generate(p["node_key"]).node_id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    p = _cfg_paths(args.home)
+    with open(p["pv_key"]) as f:
+        d = json.load(f)
+    print(json.dumps({"address": d["address"], "pub_key": d["pub_key"]}))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .p2p import NodeKey
+
+    nk = NodeKey.generate()
+    print(json.dumps({"id": nk.node_id()}))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from .privval import FilePV
+
+    pv = FilePV.generate(None, None)
+    print(json.dumps({
+        "address": pv.pub_key().address().hex(),
+        "pub_key": pv.pub_key().bytes().hex(),
+    }))
+    return 0
+
+
+def cmd_reset_all(args) -> int:
+    """reference commands/reset.go: wipe data, keep config + keys."""
+    p = _cfg_paths(args.home)
+    if os.path.isdir(p["data"]):
+        for name in os.listdir(p["data"]):
+            path = os.path.join(p["data"], name)
+            if name == "priv_validator_state.json":
+                continue
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+    os.makedirs(p["data"], exist_ok=True)
+    with open(p["pv_state"], "w") as f:
+        json.dump({"height": 0, "round": 0, "step": 0,
+                   "signature": "", "sign_bytes": ""}, f)
+    print("reset node data (privval last-sign state zeroed, keys kept)")
+    return 0
+
+
+def cmd_inspect_lite(args) -> int:
+    """reference `cometbft inspect`: serve RPC over the stores of a
+    stopped node, without consensus."""
+    from .config import Config
+    from .rpc.routes import Env
+    from .rpc.server import RPCServer
+    from .storage import BlockStore, StateStore, open_kv
+    from .types.genesis import GenesisDoc
+
+    p = _cfg_paths(args.home)
+    cfg = Config.load(p["config_file"])
+    mem = cfg.base.db_backend == "mem"
+    bs = BlockStore(open_kv(None if mem else os.path.join(args.home, "data/blockstore.db")))
+    ss = StateStore(open_kv(None if mem else os.path.join(args.home, "data/state.db")))
+    env = Env(block_store=bs, state_store=ss,
+              genesis_doc=GenesisDoc.load(p["genesis"]))
+    host, port = cfg.rpc.laddr[len("tcp://"):].rsplit(":", 1)
+    srv = RPCServer(env, host, int(port))
+    srv.start()
+    print(f"inspect rpc on {srv.addr} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cometbft_tpu")
+    ap.add_argument("--home", default=os.path.expanduser("~/.cometbft_tpu"))
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init");  sp.add_argument("--chain-id", default="local-chain"); sp.set_defaults(fn=cmd_init)
+    sp = sub.add_parser("start"); sp.set_defaults(fn=cmd_start)
+    sp = sub.add_parser("testnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output", default="./testnet")
+    sp.add_argument("--chain-id", default="testnet-chain")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+    sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
+    sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("gen-node-key").set_defaults(fn=cmd_gen_node_key)
+    sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
+    sub.add_parser("reset-all").set_defaults(fn=cmd_reset_all)
+    sub.add_parser("inspect-lite").set_defaults(fn=cmd_inspect_lite)
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Node configuration (reference config/config.go + config/toml.go).
+
+A typed Config with the reference's sections (Base, RPC, P2P, Mempool,
+Consensus, BlockSync, Storage, Instrumentation), TOML persistence, and
+per-section validation. The `crypto_backend` flag is the TPU seam: "tpu"
+routes batch verification through the device kernels, "cpu" uses the
+pure-Python oracle (SURVEY §5.6's `crypto.backend` gate).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "node"
+    home: str = "."
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    db_backend: str = "sqlite"  # sqlite | mem
+    db_dir: str = "data"
+    abci: str = "local"  # local | socket
+    proxy_app: str = "unix:///tmp/app.sock"
+    crypto_backend: str = "tpu"  # tpu | cpu
+
+    def validate(self) -> None:
+        if self.db_backend not in ("sqlite", "mem"):
+            raise ValueError(f"unknown db_backend {self.db_backend}")
+        if self.abci not in ("local", "socket"):
+            raise ValueError(f"unknown abci mode {self.abci}")
+        if self.crypto_backend not in ("tpu", "cpu"):
+            raise ValueError(f"unknown crypto_backend {self.crypto_backend}")
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_body_bytes: int = 1_000_000
+
+    def validate(self) -> None:
+        if self.max_body_bytes <= 0:
+            raise ValueError("max_body_bytes must be positive")
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://127.0.0.1:26656"
+    persistent_peers: str = ""  # comma-separated host:port
+    max_inbound_peers: int = 40
+    max_outbound_peers: int = 10
+    send_rate: int = 512_000  # bytes/s (reference 500 KB/s default)
+    recv_rate: int = 512_000
+
+    def validate(self) -> None:
+        if self.max_inbound_peers < 0 or self.max_outbound_peers < 0:
+            raise ValueError("peer limits must be >= 0")
+
+    def persistent_peer_list(self) -> list[tuple[str, int]]:
+        out = []
+        for item in filter(None, self.persistent_peers.split(",")):
+            host, port = item.strip().rsplit(":", 1)
+            out.append((host, int(port)))
+        return out
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    cache_size: int = 10000
+    max_tx_bytes: int = 1_048_576
+    keep_invalid_txs_in_cache: bool = False
+
+    def validate(self) -> None:
+        if self.size <= 0 or self.cache_size <= 0:
+            raise ValueError("mempool sizes must be positive")
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal"
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+
+    def validate(self) -> None:
+        for name in ("timeout_propose", "timeout_prevote", "timeout_precommit",
+                     "timeout_commit"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+
+    def timeouts(self):
+        from .consensus.state import TimeoutConfig
+
+        return TimeoutConfig(
+            propose=self.timeout_propose,
+            propose_delta=self.timeout_propose_delta,
+            prevote=self.timeout_prevote,
+            prevote_delta=self.timeout_prevote_delta,
+            precommit=self.timeout_precommit,
+            precommit_delta=self.timeout_precommit_delta,
+            commit=self.timeout_commit,
+        )
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+    verify_mode: str = "batched"  # batched | full
+    window: int = 32
+
+    def validate(self) -> None:
+        if self.verify_mode not in ("batched", "full"):
+            raise ValueError(f"unknown verify_mode {self.verify_mode}")
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+    def validate(self) -> None:
+        for section in (self.base, self.rpc, self.p2p, self.mempool,
+                        self.consensus, self.blocksync):
+            section.validate()
+
+    # -- paths ----------------------------------------------------------
+    def path(self, rel: str) -> str:
+        return os.path.join(self.base.home, rel)
+
+    # -- TOML -----------------------------------------------------------
+    def to_toml(self) -> str:
+        def emit(name, obj):
+            lines = [f"[{name}]"]
+            for k, v in asdict(obj).items():
+                if isinstance(v, bool):
+                    lines.append(f"{k} = {'true' if v else 'false'}")
+                elif isinstance(v, (int, float)):
+                    lines.append(f"{k} = {v}")
+                else:
+                    lines.append(f'{k} = "{v}"')
+            return "\n".join(lines)
+
+        parts = [
+            emit("base", self.base),
+            emit("rpc", self.rpc),
+            emit("p2p", self.p2p),
+            emit("mempool", self.mempool),
+            emit("consensus", self.consensus),
+            emit("blocksync", self.blocksync),
+            emit("storage", self.storage),
+            emit("instrumentation", self.instrumentation),
+        ]
+        return "\n\n".join(parts) + "\n"
+
+    @classmethod
+    def from_toml(cls, raw: str) -> "Config":
+        d = tomllib.loads(raw)
+        cfg = cls(
+            base=BaseConfig(**d.get("base", {})),
+            rpc=RPCConfig(**d.get("rpc", {})),
+            p2p=P2PConfig(**d.get("p2p", {})),
+            mempool=MempoolConfig(**d.get("mempool", {})),
+            consensus=ConsensusConfig(**d.get("consensus", {})),
+            blocksync=BlockSyncConfig(**d.get("blocksync", {})),
+            storage=StorageConfig(**d.get("storage", {})),
+            instrumentation=InstrumentationConfig(**d.get("instrumentation", {})),
+        )
+        cfg.validate()
+        return cfg
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_toml(f.read())

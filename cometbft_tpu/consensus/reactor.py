@@ -1,0 +1,141 @@
+"""Consensus reactor: gossips consensus messages over p2p channels.
+
+Behavior parity: reference internal/consensus/reactor.go — the reactor
+owns the State/Data/Vote channels (:152) and relays between the switch
+and the consensus state machine. The reference's per-peer gossip
+routines (:567,735) push deltas based on peer round state; v1 here
+broadcasts proposals/blocks/votes to all peers (loopback-net semantics
+over real sockets) — peer-state-aware gossip is the known next step.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..encoding import proto as pb
+from ..p2p.conn import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..types import Proposal, Vote
+from .state import ConsensusState, ProposalMessage, VoteMessage
+from .wal import BlockBytesMessage
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+
+
+def encode_consensus_msg(msg) -> bytes:
+    if isinstance(msg, VoteMessage):
+        return pb.f_embedded(1, msg.vote.encode())
+    if isinstance(msg, ProposalMessage):
+        return pb.f_embedded(2, msg.proposal.encode())
+    if isinstance(msg, BlockBytesMessage):
+        return pb.f_embedded(
+            3,
+            pb.f_varint(1, msg.height)
+            + pb.f_varint(2, msg.round)
+            + pb.f_bytes(3, msg.block_bytes),
+        )
+    raise TypeError(f"unsupported consensus message {type(msg)}")
+
+
+def decode_consensus_msg(buf: bytes):
+    fields = pb.parse_fields(buf)
+    if not fields:
+        raise ValueError("empty consensus message")
+    fnum, _, v = fields[0]
+    v = bytes(v)
+    if fnum == 1:
+        return VoteMessage(Vote.decode(v))
+    if fnum == 2:
+        return ProposalMessage(Proposal.decode(v))
+    if fnum == 3:
+        d = pb.fields_to_dict(v)
+        return BlockBytesMessage(
+            pb.to_i64(d.get(1, 0)), pb.to_i64(d.get(2, 0)), bytes(d.get(3, b""))
+        )
+    raise ValueError(f"unknown consensus message tag {fnum}")
+
+
+def _channel_for(msg) -> int:
+    if isinstance(msg, VoteMessage):
+        return VOTE_CHANNEL
+    if isinstance(msg, ProposalMessage):
+        return STATE_CHANNEL
+    return DATA_CHANNEL
+
+
+class ConsensusReactor(Reactor):
+    """Messages are re-gossiped on a short interval until the height moves
+    on — the liveness job of the reference's per-peer gossip routines
+    (vote/data retransmission), in broadcast form: receivers dedupe (a
+    repeated vote is a no-op in VoteSet), so retransmission is idempotent.
+    Without it, messages sent before a peer connects are lost forever and
+    a 2-validator net deadlocks at startup."""
+
+    REGOSSIP_INTERVAL_S = 0.25
+
+    def __init__(self, cs: ConsensusState):
+        self.cs = cs
+        self.switch = None
+        self._recent: list[tuple[int, object]] = []  # (height, msg)
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        cs.broadcast = self.broadcast_msg
+
+    def channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6),
+            ChannelDescriptor(DATA_CHANNEL, priority=10),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7),
+        ]
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._regossip_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _msg_height(self, msg) -> int:
+        if isinstance(msg, VoteMessage):
+            return msg.vote.height
+        if isinstance(msg, ProposalMessage):
+            return msg.proposal.height
+        return msg.height
+
+    def broadcast_msg(self, msg) -> None:
+        h = self._msg_height(msg)
+        with self._lock:
+            self._recent = [(mh, m) for mh, m in self._recent if mh >= self.cs.height]
+            self._recent.append((h, msg))
+        if self.switch is not None:
+            self.switch.broadcast(_channel_for(msg), encode_consensus_msg(msg))
+
+    def _regossip_loop(self) -> None:
+        while not self._stopped.is_set():
+            self._stopped.wait(self.REGOSSIP_INTERVAL_S)
+            if self.switch is None or not self.switch.peers():
+                continue
+            cur = self.cs.height
+            with self._lock:
+                batch = [m for mh, m in self._recent if mh >= cur]
+            for msg in batch:
+                self.switch.broadcast(
+                    _channel_for(msg), encode_consensus_msg(msg)
+                )
+
+    def add_peer(self, peer) -> None:
+        """Catch a late joiner up on the current height's messages."""
+        cur = self.cs.height
+        with self._lock:
+            batch = [m for mh, m in self._recent if mh >= cur]
+        for msg in batch:
+            peer.send(_channel_for(msg), encode_consensus_msg(msg))
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        self.cs.send(decode_consensus_msg(msg), peer_id=peer.id)

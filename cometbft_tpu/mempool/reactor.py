@@ -1,0 +1,39 @@
+"""Mempool reactor: tx gossip over p2p (reference mempool/reactor.go).
+
+Broadcasts newly admitted txs to peers on the mempool channel; received
+txs go through CheckTx with the sender recorded so they are not echoed
+back (the reference tracks per-peer send state; v1 relies on the LRU
+cache to stop loops)."""
+
+from __future__ import annotations
+
+from ..encoding import proto as pb
+from ..p2p.conn import ChannelDescriptor
+from ..p2p.switch import Reactor
+
+MEMPOOL_CHANNEL = 0x30
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool):
+        self.mempool = mempool
+        self.switch = None
+        mempool.on_new_tx.append(self._broadcast_tx)
+
+    def channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5)]
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+
+    def _broadcast_tx(self, tx: bytes) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(MEMPOOL_CHANNEL, pb.f_bytes(1, tx, emit_empty=True))
+
+    def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        d = pb.fields_to_dict(msg)
+        tx = bytes(d.get(1, b""))
+        try:
+            self.mempool.check_tx(tx, from_peer=peer.id)
+        except Exception:  # noqa: BLE001 — dup/full/invalid: drop
+            pass

@@ -1,0 +1,195 @@
+"""Node assembly: wire every subsystem into a runnable validator.
+
+Behavior parity: reference node/node.go NewNode (:264-520) wiring order —
+DBs -> state store -> genesis -> proxy app conns -> handshake/replay ->
+mempool -> evidence -> block executor -> consensus (+WAL, privval) ->
+transport -> switch (+reactors) -> dial persistent peers. OnStart (:523)
+listens, starts reactors, dials.
+
+The RPC server attaches via rpc.server.serve(node) (reference startRPC).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..abci.client import AppConns
+from ..abci.socket import SocketAppConns
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.state import ConsensusState
+from ..consensus.wal import WAL
+from ..evidence import EvidencePool
+from ..mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p import NodeKey, Switch, Transport
+from ..p2p.transport import NodeInfo
+from ..privval import FilePV
+from ..rpc.routes import Env
+from ..rpc.server import RPCServer
+from ..state.execution import BlockExecutor, make_genesis_state
+from ..state.handshake import Handshaker
+from ..storage import BlockStore, StateStore, open_kv
+from ..storage.indexer import BlockIndexer, IndexerService, TxIndexer
+from ..types.event_bus import EventBus
+from ..types.genesis import GenesisDoc
+
+
+class Node:
+    def __init__(self, config: Config, app=None, genesis: GenesisDoc | None = None):
+        """app: an in-process Application (abci=local); with abci=socket the
+        node connects to config.base.proxy_app instead."""
+        self.config = config
+        config.validate()
+        home = config.base.home
+
+        def _p(rel: str) -> str:
+            path = os.path.join(home, rel)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            return path
+
+        # --- genesis ---------------------------------------------------
+        self.genesis_doc = genesis or GenesisDoc.load(_p(config.base.genesis_file))
+        self.genesis_doc.validate_basic()
+
+        # --- stores ----------------------------------------------------
+        mem = config.base.db_backend == "mem"
+        self.block_store = BlockStore(
+            open_kv(None if mem else _p("data/blockstore.db"))
+        )
+        self.state_store = StateStore(
+            open_kv(None if mem else _p("data/state.db"))
+        )
+
+        # --- app conns -------------------------------------------------
+        if config.base.abci == "local":
+            if app is None:
+                raise ValueError("abci=local requires an in-process app")
+            self.app_conns = AppConns(app)
+        else:
+            self.app_conns = SocketAppConns(config.base.proxy_app)
+
+        # --- identity --------------------------------------------------
+        self.node_key = NodeKey.load_or_generate(_p(config.base.node_key_file))
+        kf = _p(config.base.priv_validator_key_file)
+        sf = _p(config.base.priv_validator_state_file)
+        self.priv_validator = (
+            FilePV.load(kf, sf) if os.path.exists(kf) else FilePV.generate(kf, sf)
+        )
+
+        # --- handshake / replay ---------------------------------------
+        genesis_state = make_genesis_state(
+            self.genesis_doc.chain_id,
+            self.genesis_doc.validator_set(),
+            app_hash=self.genesis_doc.app_hash,
+            initial_height=self.genesis_doc.initial_height,
+            genesis_time=self.genesis_doc.genesis_time,
+        )
+        self.handshaker = Handshaker(
+            self.state_store, self.block_store, genesis_state,
+            backend=config.base.crypto_backend,
+        )
+        sm_state = self.handshaker.handshake(self.app_conns)
+
+        # --- mempool / evidence / executor ----------------------------
+        self.mempool = CListMempool(
+            self.app_conns,
+            max_txs=config.mempool.size,
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            cache_size=config.mempool.cache_size,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+        )
+        self.evidence_pool = EvidencePool(
+            state_store=self.state_store, block_store=self.block_store,
+            chain_id=self.genesis_doc.chain_id,
+        )
+        self.event_bus = EventBus()
+        self.tx_indexer = TxIndexer()
+        self.block_indexer = BlockIndexer()
+        self.indexer_service = IndexerService(
+            self.event_bus, self.tx_indexer, self.block_indexer
+        )
+        self.executor = BlockExecutor(
+            self.app_conns,
+            state_store=self.state_store,
+            block_store=self.block_store,
+            backend=config.base.crypto_backend,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+
+        # --- consensus -------------------------------------------------
+        self.wal = WAL(_p(config.consensus.wal_file))
+        self.consensus = ConsensusState(
+            chain_id=self.genesis_doc.chain_id,
+            sm_state=sm_state,
+            executor=self.executor,
+            block_store=self.block_store,
+            privval=self.priv_validator,
+            wal=self.wal,
+            timeouts=config.consensus.timeouts(),
+            tx_source=lambda: self.mempool.reap_max_bytes_max_gas(
+                max_bytes=1 << 20
+            ),
+            name=config.base.moniker,
+        )
+
+        # --- p2p -------------------------------------------------------
+        info = NodeInfo(
+            node_id=self.node_key.node_id(),
+            network=self.genesis_doc.chain_id,
+            moniker=config.base.moniker,
+        )
+        self.transport = Transport(self.node_key, info)
+        self.switch = Switch(self.transport)
+        self.consensus_reactor = ConsensusReactor(self.consensus)
+        self.consensus_reactor.set_switch(self.switch)
+        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor.set_switch(self.switch)
+        self.switch.add_reactor(self.consensus_reactor)
+        self.switch.add_reactor(self.mempool_reactor)
+        self.rpc_env = Env(
+            block_store=self.block_store,
+            state_store=self.state_store,
+            consensus=self.consensus,
+            mempool=self.mempool,
+            switch=self.switch,
+            event_bus=self.event_bus,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            genesis_doc=self.genesis_doc,
+            app_conns=self.app_conns,
+            node_info=info,
+        )
+        self.rpc_server = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        host, port = "127.0.0.1", 0
+        laddr = self.config.p2p.laddr
+        if laddr.startswith("tcp://"):
+            host, p = laddr[len("tcp://"):].rsplit(":", 1)
+            port = int(p)
+        self.listen_addr = self.transport.listen(host, port)
+        self.switch.start()
+        rladdr = self.config.rpc.laddr
+        if rladdr.startswith("tcp://"):
+            rhost, rport = rladdr[len("tcp://"):].rsplit(":", 1)
+            self.rpc_server = RPCServer(self.rpc_env, rhost, int(rport))
+            self.rpc_server.start()
+            self.rpc_addr = self.rpc_server.addr
+        for hostp, portp in self.config.p2p.persistent_peer_list():
+            try:
+                self.switch.dial_peer(hostp, portp)
+            except Exception:  # noqa: BLE001 — reference retries async
+                pass
+        self.consensus.start()
+
+    def stop(self) -> None:
+        self.consensus.stop()
+        self.consensus_reactor.stop()
+        self.switch.stop()
+        self.indexer_service.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()

@@ -193,20 +193,6 @@ def _host_base_niels() -> np.ndarray:
 BASE_NIELS = jnp.asarray(_host_base_niels())  # (9, 3, 22)
 
 
-def _signed_select_base(digits):
-    """digits: (B,) int32 in [-8, 7] -> affine niels of [digit]B."""
-    sign = digits < 0
-    idx = jnp.abs(digits)
-    mask = (idx[None, :] == jnp.arange(9, dtype=jnp.int32)[:, None]).astype(jnp.int32)
-    sel = jnp.einsum("tb,tcl->clb", mask, BASE_NIELS)  # (3, 22, B)
-    ypx, ymx, t2d = sel[0], sel[1], sel[2]
-    return (
-        F.select(sign, ymx, ypx),
-        F.select(sign, ypx, ymx),
-        F.select(sign, F.neg(t2d), t2d),
-    )
-
-
 def lane_table(p):
     """Per-lane niels table of [i]p for i in 0..8, one (9, 4, 22, B) array.
 
@@ -230,19 +216,111 @@ def lane_table(p):
     return jnp.concatenate([head, rest], axis=0)  # (9, 4, 22, B)
 
 
-def _signed_select_lane(table, digits):
-    """Select [digit]p from a (9, 4, 22, B) niels table, digit in [-8, 7]."""
-    sign = digits < 0
-    idx = jnp.abs(digits)
-    mask = (idx[None, :] == jnp.arange(9, dtype=jnp.int32)[:, None]).astype(jnp.int32)
-    sel = (mask[:, None, None, :] * table).sum(0)  # (4, 22, B)
-    ypx, ymx, t2d, z2 = sel[0], sel[1], sel[2], sel[3]
+def _select_rows(rows, ncomps, idx_row, batch):
+    """Select a table entry per lane by a (1, B) index in 0..8.
+
+    rows(entry, comp) -> (22, ?) array; where-loop formulation
+    (kernel-safe: no einsum/gather). Returns `ncomps` (22, B) arrays."""
+    comps = []
+    for c in range(ncomps):
+        acc = None
+        for e in range(9):
+            row = jnp.broadcast_to(rows(e, c), (F.NLIMBS, batch))
+            term = jnp.where(idx_row == e, row, 0)
+            acc = term if acc is None else acc + term
+        comps.append(acc)
+    return comps
+
+
+def _apply_sign_affine(sign_row, ypx, ymx, t2d):
     return (
-        F.select(sign, ymx, ypx),
-        F.select(sign, ypx, ymx),
-        F.select(sign, F.neg(t2d), t2d),
-        z2,
+        jnp.where(sign_row, ymx, ypx),
+        jnp.where(sign_row, ypx, ymx),
+        jnp.where(sign_row, F.neg(t2d), t2d),
     )
+
+
+def _base_madd(r, ws_row):
+    """madd of [digit]B from the constant base table (signed select)."""
+    ypx, ymx, t2d = _select_rows(
+        lambda e, c: BASE_NIELS[e, c][:, None], 3, jnp.abs(ws_row),
+        ws_row.shape[1],
+    )
+    return madd(r, _apply_sign_affine(ws_row < 0, ypx, ymx, t2d))
+
+
+def _window_step(r, tbl_rows, ws_row, wk_row):
+    """One radix-16 window: 4 doublings + base madd + lane add.
+
+    r: extended point of (22, B) arrays; tbl_rows: callable(entry, comp)
+    -> (22, B) lane-table component; ws_row/wk_row: (1, B) signed digits.
+    Pure value-form — runs identically inside the Pallas kernel and on
+    the XLA (CPU) path.
+    """
+    r = dbl_no_t(r)
+    r = dbl_no_t(r)
+    r = dbl_no_t(r)
+    r = dbl(r)
+    r = _base_madd(r, ws_row)
+    # lane-table niels add (4th component z2 carries no sign)
+    lypx, lymx, lt2d, lz2 = _select_rows(
+        tbl_rows, 4, jnp.abs(wk_row), wk_row.shape[1]
+    )
+    ypx, ymx, t2d = _apply_sign_affine(wk_row < 0, lypx, lymx, lt2d)
+    return add_niels(r, (ypx, ymx, t2d, lz2))
+
+
+def _window_kernel(x_ref, y_ref, z_ref, t_ref_in, tbl_ref, ws_ref, wk_ref,
+                   xo, yo, zo, to, scratch):
+    """Fused Pallas kernel: ONE launch per ladder window (instead of ~80
+    small kernels); all 44 field muls share the VMEM conv scratch."""
+    with F.kernel_mode(scratch):
+        r = (x_ref[...], y_ref[...], z_ref[...], t_ref_in[...])
+        nl = F.NLIMBS
+
+        def tbl_rows(e, c):
+            base = (e * 4 + c) * nl
+            return tbl_ref[base : base + nl, :]
+
+        X, Y, Z, T = _window_step(r, tbl_rows, ws_ref[...], wk_ref[...])
+    xo[...], yo[...], zo[...], to[...] = X, Y, Z, T
+
+
+def _ladder_pallas(s_digits, k_digits, a_point):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch = s_digits.shape[1]
+    tbl = lane_table(a_point)  # (9, 4, 22, B)
+    tbl_flat = tbl.reshape(9 * 4 * F.NLIMBS, batch)
+    tile = min(batch, F._PALLAS_TILE)
+    nl = F.NLIMBS
+
+    point_spec = pl.BlockSpec((nl, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    tbl_spec = pl.BlockSpec(
+        (9 * 4 * nl, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    dig_spec = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    call = pl.pallas_call(
+        _window_kernel,
+        out_shape=[jax.ShapeDtypeStruct((nl, batch), jnp.int32)] * 4,
+        grid=(batch // tile,),
+        in_specs=[point_spec] * 4 + [tbl_spec, dig_spec, dig_spec],
+        out_specs=[point_spec] * 4,
+        scratch_shapes=[pltpu.VMEM((F._WIDE, tile), jnp.int32)],
+    )
+
+    xs = (jnp.flip(s_digits, axis=0), jnp.flip(k_digits, axis=0))
+
+    def body(r, w):
+        ws, wk = w
+        out = call(r[0], r[1], r[2], r[3], tbl_flat,
+                   ws[None, :], wk[None, :])
+        return tuple(out), None
+
+    r, _ = lax.scan(body, tuple(identity(batch)), xs)
+    return r
 
 
 def ladder(s_digits, k_digits, a_point):
@@ -250,22 +328,24 @@ def ladder(s_digits, k_digits, a_point):
 
     s_digits, k_digits: (64, B) int32 in [-8, 7], little-endian (digit i
     weighs 16^i) — from ops.scalar.recode_signed. a_point: batched extended
-    point. Scans digits from most to least significant under lax.scan;
-    every window does 3 T-less doublings + 1 full doubling + a base-table
-    madd + a lane-table niels add. No data-dependent control flow.
+    point. Scans digits from most to least significant; on TPU each window
+    is ONE fused Pallas kernel launch. No data-dependent control flow.
     """
     batch = s_digits.shape[1]
+    if F._use_pallas(s_digits):
+        return _ladder_pallas(s_digits, k_digits, a_point)
     tbl = lane_table(a_point)
     xs = (jnp.flip(s_digits, axis=0), jnp.flip(k_digits, axis=0))
 
+    def tbl_rows_factory(tblv):
+        def tbl_rows(e, c):
+            return tblv[e, c]
+
+        return tbl_rows
+
     def body(r, w):
         ws, wk = w
-        r = dbl_no_t(r)
-        r = dbl_no_t(r)
-        r = dbl_no_t(r)
-        r = dbl(r)
-        r = madd(r, _signed_select_base(ws))
-        r = add_niels(r, _signed_select_lane(tbl, wk))
+        r = _window_step(r, tbl_rows_factory(tbl), ws[None, :], wk[None, :])
         return r, None
 
     r0 = identity(batch)
@@ -282,7 +362,7 @@ def fixed_base(s_digits):
         r = dbl_no_t(r)
         r = dbl_no_t(r)
         r = dbl(r)
-        r = madd(r, _signed_select_base(ws))
+        r = _base_madd(r, ws[None, :])
         return r, None
 
     r, _ = lax.scan(body, identity(batch), jnp.flip(s_digits, axis=0))

@@ -174,6 +174,31 @@ def _conv_into_scratch(a, b, t_ref):
     return t_ref[...]
 
 
+# --- kernel context: lets the shared curve/scalar code run INSIDE a fused
+# Pallas kernel. When set (trace time only), mul/sq use the kernel's conv
+# scratch ref instead of nesting pallas_call (which is illegal).
+_KERNEL_SCRATCH = None
+
+
+class kernel_mode:
+    """Context manager marking that field ops are being traced inside a
+    Pallas kernel body, with `scratch` as the shared (45, Bt) conv ref."""
+
+    def __init__(self, scratch):
+        self.scratch = scratch
+
+    def __enter__(self):
+        global _KERNEL_SCRATCH
+        self._prev = _KERNEL_SCRATCH
+        _KERNEL_SCRATCH = self.scratch
+        return self
+
+    def __exit__(self, *exc):
+        global _KERNEL_SCRATCH
+        _KERNEL_SCRATCH = self._prev
+        return False
+
+
 def _mul_kernel(a_ref, b_ref, o_ref, t_ref):
     o_ref[...] = _fold_wide(_conv_into_scratch(a_ref[...], b_ref[...], t_ref))
 
@@ -229,6 +254,8 @@ def mul(a, b):
     Product limbs t[k] = sum_{i+j=k} a[i]b[j] < 2^29 (loose bound above).
     """
     a, b = _bcast(jnp.asarray(a), jnp.asarray(b))
+    if _KERNEL_SCRATCH is not None:
+        return _fold_wide(_conv_into_scratch(a, b, _KERNEL_SCRATCH))
     if _use_pallas(a, b):
         return _pallas_binop(_mul_kernel, a, b)
     return _fold_wide(_conv_rows_shifted(a, b))
@@ -237,6 +264,8 @@ def mul(a, b):
 def sq(a):
     """Squaring: one-input variant of mul (halves HBM reads on TPU)."""
     a = jnp.asarray(a)
+    if _KERNEL_SCRATCH is not None:
+        return _fold_wide(_conv_into_scratch(a, a, _KERNEL_SCRATCH))
     if _use_pallas(a):
         return _pallas_binop(_sq_kernel, a)
     return _fold_wide(_conv_rows_shifted(a, a))
@@ -252,14 +281,23 @@ def mul_small(a, c: int):
 
 
 def _seq_pass(x):
-    """Sequential carry pass without fold; returns (limbs, carry_out)."""
+    """Sequential carry pass without fold; returns (limbs, carry_out (1,B)).
+
+    Kernel-safe formulation: rows stay 2D and the result is a concat (no
+    stack/scatter, which Mosaic cannot lower).
+    """
     out = []
-    c = jnp.zeros_like(x[0])
+    c = jnp.zeros_like(x[0:1])
     for j in range(NLIMBS):
-        t = x[j] + c
+        t = x[j : j + 1] + c
         out.append(t & MASK)
         c = t >> BITS
-    return jnp.stack(out), c
+    return jnp.concatenate(out, axis=0), c
+
+
+def _edit_row0(a, delta):
+    """a with delta (1,B) added to limb 0 (value-level, kernel-safe)."""
+    return jnp.concatenate([a[0:1] + delta, a[1:]], axis=0)
 
 
 def freeze(a):
@@ -270,20 +308,20 @@ def freeze(a):
     """
     a = carry(a)
     a, c = _seq_pass(a)
-    a = a.at[0].add(FOLD * c)
+    a = _edit_row0(a, FOLD * c)
     a, c = _seq_pass(a)
-    a = a.at[0].add(FOLD * c)
+    a = _edit_row0(a, FOLD * c)
     a, _ = _seq_pass(a)
     # Fold bits >= 255 out of the top limb (bits 252..263 live there).
-    top = a[NLIMBS - 1] >> 3
-    a = a.at[NLIMBS - 1].set(a[NLIMBS - 1] & 7)
-    a = a.at[0].add(19 * top)
+    top = a[NLIMBS - 1 : NLIMBS] >> 3
+    a = jnp.concatenate([a[: NLIMBS - 1], a[NLIMBS - 1 : NLIMBS] & 7], axis=0)
+    a = _edit_row0(a, 19 * top)
     a, _ = _seq_pass(a)  # value now < 2^255 + eps < 2p
     # Conditional subtract p.
     d = a - jnp.asarray(P_LIMBS[:, None])
     d, c = _seq_pass(d)
     nonneg = c == 0  # borrow-free => a >= p
-    return jnp.where(nonneg[None], d, a)
+    return jnp.where(nonneg, d, a)
 
 
 def eq(a, b):
@@ -306,11 +344,13 @@ def select(cond, a, b):
 
 
 def sqn(x, n: int):
-    """n repeated squarings via lax.scan (keeps the traced graph small)."""
+    """n repeated squarings via a loop primitive (small traced graph)."""
     if n <= 2:
         for _ in range(n):
             x = sq(x)
         return x
+    if _KERNEL_SCRATCH is not None:
+        return lax.fori_loop(0, n, lambda i, v: sq(v), x)
     return lax.scan(lambda c, _: (sq(c), None), x, None, length=n)[0]
 
 

@@ -74,20 +74,21 @@ def _canon(x, extra_rounds: int = 2):
 
     A few parallel rounds shrink carries to <= 1, then one unrolled
     sequential ripple finishes exactly. Input limbs must be >= 0.
-    The final carry out of the top limb is returned (callers for which it
-    must be zero assert statically via value bounds).
+    The final carry out of the top limb is returned as (1, B) (callers for
+    which it must be zero assert statically via value bounds). Rows stay
+    2D (kernel-safe: no stack/scatter).
     """
     for _ in range(extra_rounds):
         m = x & MASK
         hi = x >> BITS
         x = m + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
     out = []
-    c = jnp.zeros_like(x[0])
+    c = jnp.zeros_like(x[0:1])
     for j in range(x.shape[0]):
-        t = x[j] + c
+        t = x[j : j + 1] + c
         out.append(t & MASK)
         c = t >> BITS
-    return jnp.stack(out), c
+    return jnp.concatenate(out, axis=0), c
 
 
 def _conv(a, b):
@@ -113,18 +114,18 @@ def _conv(a, b):
 
 
 def _sub_borrow(a, b):
-    """a - b limbwise with sequential borrow. Returns (diff, borrow_out).
+    """a - b limbwise with sequential borrow. Returns (diff, borrow (1,B)).
 
     a, b canonical limbs of equal length; diff is the base-2^12 two's
     complement result (i.e. a - b mod b^n), borrow_out is 1 where a < b.
     """
     out = []
-    c = jnp.zeros_like(a[0])
+    c = jnp.zeros_like(a[0:1])
     for j in range(a.shape[0]):
-        t = a[j] - b[j] - c
+        t = a[j : j + 1] - b[j : j + 1] - c
         out.append(t & MASK)
         c = (t >> BITS) & 1  # arithmetic shift of negative -> -1; mask to 1
-    return jnp.stack(out), c
+    return jnp.concatenate(out, axis=0), c
 
 
 def reduce512(digest_bytes):
@@ -146,7 +147,7 @@ def reduce512(digest_bytes):
          jnp.zeros((1, r.shape[1]), jnp.int32)], axis=0)
     for _ in range(2):  # Barrett leaves r < 3L
         d, borrow = _sub_borrow(r, lpad)
-        r = jnp.where(borrow[None, :] == 0, d, r)
+        r = jnp.where(borrow == 0, d, r)
     return r[:_K]
 
 
@@ -154,7 +155,7 @@ def lt_l(s_bytes):
     """(B, 32) uint8 little-endian -> bool (B,): value < L (ZIP-215 S check)."""
     s = bytes_to_limbs(s_bytes, _K)
     _, borrow = _sub_borrow(s, jnp.broadcast_to(L_LIMBS, s.shape))
-    return borrow == 1
+    return (borrow == 1)[0]
 
 
 def recode_signed(limbs):
@@ -168,9 +169,9 @@ def recode_signed(limbs):
     digits = []
     for i in range(64):
         limb, pos = divmod(4 * i, BITS)
-        nib = (t[limb] >> pos) & 15
+        nib = (t[limb : limb + 1] >> pos) & 15
         digits.append(nib - 8)
-    return jnp.stack(digits)
+    return jnp.concatenate(digits, axis=0)
 
 
 def digits_from_bytes(b32):

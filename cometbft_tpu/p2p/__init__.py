@@ -1,0 +1,16 @@
+from .key import NodeKey
+from .secret_connection import SecretConnection
+from .conn import ChannelDescriptor, MConnection
+from .switch import Switch, Reactor
+from .transport import Transport, NodeInfo
+
+__all__ = [
+    "NodeKey",
+    "SecretConnection",
+    "ChannelDescriptor",
+    "MConnection",
+    "Switch",
+    "Reactor",
+    "Transport",
+    "NodeInfo",
+]

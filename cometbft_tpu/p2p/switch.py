@@ -1,0 +1,138 @@
+"""Peer switch: lifecycle + reactor registry + broadcast.
+
+Behavior parity: reference p2p/switch.go — reactors claim channels
+(:71 AddReactor), accept loop adds inbound peers (:631), DialPeer adds
+outbound ones (:396), Broadcast fans a message to every peer's channel
+(:272), errors evict the peer (StopPeerForError :333).
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+
+from .conn import ChannelDescriptor, MConnection
+from .transport import NodeInfo, Transport
+
+
+class Reactor(ABC):
+    """reference p2p/base_reactor.go Reactor."""
+
+    @abstractmethod
+    def channels(self) -> list[ChannelDescriptor]: ...
+
+    @abstractmethod
+    def receive(self, chan_id: int, peer: "Peer", msg: bytes) -> None: ...
+
+    def add_peer(self, peer: "Peer") -> None: ...
+
+    def remove_peer(self, peer: "Peer", reason) -> None: ...
+
+
+class Peer:
+    def __init__(self, node_info: NodeInfo, mconn: MConnection, outbound: bool):
+        self.node_info = node_info
+        self.mconn = mconn
+        self.outbound = outbound
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def send(self, chan_id: int, msg: bytes) -> bool:
+        return self.mconn.send(chan_id, msg)
+
+    def stop(self) -> None:
+        self.mconn.stop()
+
+
+class Switch:
+    def __init__(self, transport: Transport):
+        self.transport = transport
+        self._reactors: list[Reactor] = []
+        self._chan_owner: dict[int, Reactor] = {}
+        self._descs: list[ChannelDescriptor] = []
+        self._peers: dict[str, Peer] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def add_reactor(self, reactor: Reactor) -> None:
+        for desc in reactor.channels():
+            if desc.id in self._chan_owner:
+                raise ValueError(f"channel {desc.id} already claimed")
+            self._chan_owner[desc.id] = reactor
+            self._descs.append(desc)
+        self._reactors.append(reactor)
+        # advertise channels in the node info
+        self.transport.node_info.channels = bytes(
+            sorted(self._chan_owner.keys())
+        )
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                got = self.transport.accept()
+            except Exception:  # noqa: BLE001 — failed upgrade: keep accepting
+                continue
+            if got is None:
+                return
+            self._add_peer(*got, outbound=False)
+
+    def dial_peer(self, host: str, port: int) -> Peer:
+        sc, info = self.transport.dial(host, port)
+        return self._add_peer(sc, info, outbound=True)
+
+    def _add_peer(self, sconn, info: NodeInfo, outbound: bool) -> Peer:
+        holder: dict = {}
+
+        def on_receive(chan_id: int, msg: bytes) -> None:
+            reactor = self._chan_owner.get(chan_id)
+            if reactor is not None:
+                reactor.receive(chan_id, holder["peer"], msg)
+
+        def on_error(exc) -> None:
+            self.stop_peer_for_error(holder["peer"], exc)
+
+        mconn = MConnection(sconn, self._descs, on_receive, on_error)
+        peer = Peer(info, mconn, outbound)
+        holder["peer"] = peer
+        with self._lock:
+            if peer.id in self._peers or peer.id == self.transport.node_info.node_id:
+                sconn.close()
+                raise ValueError(f"duplicate or self peer {peer.id}")
+            self._peers[peer.id] = peer
+        mconn.start()
+        for r in self._reactors:
+            r.add_peer(peer)
+        return peer
+
+    # ------------------------------------------------------------------
+    def peers(self) -> list[Peer]:
+        with self._lock:
+            return list(self._peers.values())
+
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
+        for peer in self.peers():
+            peer.send(chan_id, msg)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        with self._lock:
+            if self._peers.get(peer.id) is not peer:
+                return
+            del self._peers[peer.id]
+        peer.stop()
+        for r in self._reactors:
+            r.remove_peer(peer, reason)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.transport.close()
+        for peer in self.peers():
+            peer.stop()

@@ -100,6 +100,8 @@ class FilePV:
         pv = cls(Ed25519PrivKey.generate(), key_path, state_path)
         if key_path:
             pv._save_key()
+        if state_path:
+            pv._save_state()  # reference writes both files at gen time
         return pv
 
     @classmethod

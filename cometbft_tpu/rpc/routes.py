@@ -1,0 +1,398 @@
+"""RPC route handlers (reference rpc/core/routes.go + rpc/core/*.go).
+
+Every handler takes the Env (handles to the node's stores and services,
+reference rpc/core/env.go) and JSON params, returning JSON-able dicts.
+Bytes are hex-encoded strings; blocks/commits are rendered structurally.
+"""
+
+from __future__ import annotations
+
+from ..crypto.keys import tmhash
+from ..mempool.mempool import ErrMempoolFull, ErrTxInCache
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class Env:
+    """reference rpc/core/env.go Environment."""
+
+    def __init__(self, *, block_store=None, state_store=None, consensus=None,
+                 mempool=None, switch=None, event_bus=None, tx_indexer=None,
+                 block_indexer=None, genesis_doc=None, app_conns=None,
+                 node_info=None):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.consensus = consensus
+        self.mempool = mempool
+        self.switch = switch
+        self.event_bus = event_bus
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.genesis_doc = genesis_doc
+        self.app_conns = app_conns
+        self.node_info = node_info
+
+
+def _hx(b: bytes | None) -> str:
+    return (b or b"").hex().upper()
+
+
+def _header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": {"seconds": h.time.seconds, "nanos": h.time.nanos},
+        "last_block_id": {"hash": _hx(h.last_block_id.hash)},
+        "last_commit_hash": _hx(h.last_commit_hash),
+        "data_hash": _hx(h.data_hash),
+        "validators_hash": _hx(h.validators_hash),
+        "next_validators_hash": _hx(h.next_validators_hash),
+        "consensus_hash": _hx(h.consensus_hash),
+        "app_hash": _hx(h.app_hash),
+        "last_results_hash": _hx(h.last_results_hash),
+        "evidence_hash": _hx(h.evidence_hash),
+        "proposer_address": _hx(h.proposer_address),
+    }
+
+
+def _commit_json(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": {"hash": _hx(c.block_id.hash)},
+        "signatures": [
+            {
+                "block_id_flag": int(cs.block_id_flag),
+                "validator_address": _hx(cs.validator_address),
+                "timestamp": {"seconds": cs.timestamp.seconds,
+                              "nanos": cs.timestamp.nanos},
+                "signature": _hx(cs.signature),
+            }
+            for cs in c.signatures
+        ],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [_hx(tx) for tx in b.data.txs]},
+        "last_commit": _commit_json(b.last_commit),
+    }
+
+
+# ------------------------------------------------------------------ routes
+def health(env, params):
+    return {}
+
+
+def status(env, params):
+    bs = env.block_store
+    latest = bs.height() if bs else 0
+    header = None
+    if bs and latest:
+        blk = bs.load_block(latest)
+        header = blk.header if blk else None
+    return {
+        "node_info": {
+            "id": env.node_info.node_id if env.node_info else "",
+            "network": env.genesis_doc.chain_id if env.genesis_doc else "",
+            "moniker": env.node_info.moniker if env.node_info else "",
+        },
+        "sync_info": {
+            "latest_block_height": str(latest),
+            "latest_block_hash": _hx(header.hash() if header else b""),
+            "latest_app_hash": _hx(
+                env.consensus.sm_state.app_hash if env.consensus else b""
+            ),
+            "catching_up": False,
+        },
+        "validator_info": {
+            "address": _hx(
+                env.consensus.privval.address()
+                if env.consensus and env.consensus.privval else b""
+            ),
+        },
+    }
+
+
+def abci_info(env, params):
+    info = env.app_conns.query.info()
+    return {
+        "response": {
+            "data": info.data,
+            "version": info.version,
+            "last_block_height": str(info.last_block_height),
+            "last_block_app_hash": _hx(info.last_block_app_hash),
+        }
+    }
+
+
+def abci_query(env, params):
+    path = params.get("path", "")
+    data = bytes.fromhex(params.get("data", ""))
+    height = int(params.get("height", 0))
+    r = env.app_conns.query.query(path, data, height)
+    return {
+        "response": {
+            "code": r.code,
+            "key": _hx(r.key),
+            "value": _hx(r.value),
+            "height": str(r.height),
+            "log": r.log,
+        }
+    }
+
+
+def _get_height(env, params, default_latest=True):
+    h = params.get("height")
+    if h is None:
+        if not default_latest:
+            raise RPCError(-32602, "height required")
+        return env.block_store.height()
+    return int(h)
+
+
+def block(env, params):
+    h = _get_height(env, params)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(-32603, f"no block at height {h}")
+    return {"block_id": {"hash": _hx(blk.hash())}, "block": _block_json(blk)}
+
+
+def block_by_hash(env, params):
+    want = bytes.fromhex(params.get("hash", ""))
+    bs = env.block_store
+    for h in range(bs.height(), max(bs.base(), 1) - 1, -1):
+        blk = bs.load_block(h)
+        if blk is not None and blk.hash() == want:
+            return {"block_id": {"hash": _hx(want)}, "block": _block_json(blk)}
+    raise RPCError(-32603, "block not found")
+
+
+def header(env, params):
+    h = _get_height(env, params)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(-32603, f"no block at height {h}")
+    return {"header": _header_json(blk.header)}
+
+
+def commit(env, params):
+    h = _get_height(env, params)
+    blk = env.block_store.load_block(h)
+    c = env.block_store.load_block_commit(h) or env.block_store.load_seen_commit(h)
+    if blk is None or c is None:
+        raise RPCError(-32603, f"no commit at height {h}")
+    return {
+        "signed_header": {
+            "header": _header_json(blk.header),
+            "commit": _commit_json(c),
+        },
+        "canonical": env.block_store.load_block_commit(h) is not None,
+    }
+
+
+def block_results(env, params):
+    h = _get_height(env, params)
+    raw = env.state_store.load_finalize_response(h) if env.state_store else None
+    return {"height": str(h), "results_hash": _hx(raw or b"")}
+
+
+def validators(env, params):
+    h = _get_height(env, params)
+    vals = env.state_store.load_validators(h) if env.state_store else None
+    if vals is None:
+        raise RPCError(-32603, f"no validators at height {h}")
+    return {
+        "block_height": str(h),
+        "validators": [
+            {
+                "address": _hx(v.address),
+                "pub_key": _hx(v.pub_key.bytes()),
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            }
+            for v in vals.validators
+        ],
+        "count": str(len(vals)),
+        "total": str(len(vals)),
+    }
+
+
+def genesis(env, params):
+    import json as _json
+
+    return {"genesis": _json.loads(env.genesis_doc.to_json())}
+
+
+def net_info(env, params):
+    peers = env.switch.peers() if env.switch else []
+    return {
+        "listening": True,
+        "n_peers": str(len(peers)),
+        "peers": [
+            {"node_info": {"id": p.id, "moniker": p.node_info.moniker}}
+            for p in peers
+        ],
+    }
+
+
+def consensus_state(env, params):
+    cs = env.consensus
+    return {
+        "round_state": {
+            "height": str(cs.height),
+            "round": cs.round,
+            "step": int(cs.step),
+            "locked_round": cs.locked_round,
+            "valid_round": cs.valid_round,
+        }
+    }
+
+
+def consensus_params(env, params):
+    p = env.consensus.sm_state.consensus_params
+    return {
+        "consensus_params": {
+            "block": {"max_bytes": str(p.block.max_bytes),
+                      "max_gas": str(p.block.max_gas)},
+            "evidence": {
+                "max_age_num_blocks": str(p.evidence.max_age_num_blocks),
+                "max_bytes": str(p.evidence.max_bytes),
+            },
+        }
+    }
+
+
+def broadcast_tx_sync(env, params):
+    tx = bytes.fromhex(params["tx"])
+    try:
+        env.mempool.check_tx(tx)
+        code, log = 0, ""
+    except (ErrTxInCache, ErrMempoolFull, ValueError) as e:
+        code, log = 1, str(e)
+    return {"code": code, "log": log, "hash": _hx(tmhash(tx))}
+
+
+def broadcast_tx_async(env, params):
+    tx = bytes.fromhex(params["tx"])
+    try:
+        env.mempool.check_tx(tx)
+    except Exception:  # noqa: BLE001 — async: fire and forget
+        pass
+    return {"code": 0, "hash": _hx(tmhash(tx))}
+
+
+def broadcast_tx_commit(env, params, timeout_s: float = 30.0):
+    """Submit and wait for the tx to land in a block (reference
+    rpc/core/mempool.go BroadcastTxCommit via event subscription)."""
+    tx = bytes.fromhex(params["tx"])
+    sub = env.event_bus.subscribe(
+        f"btc-{tmhash(tx).hex()[:8]}", f"tm.event = 'Tx' AND tx.hash = '{_hx(tmhash(tx))}'"
+    )
+    try:
+        env.mempool.check_tx(tx)
+        msg = sub.next(timeout=timeout_s)
+        if msg is None:
+            raise RPCError(-32603, "timed out waiting for tx commit")
+        return {
+            "check_tx": {"code": 0},
+            "tx_result": {"code": getattr(msg.data["result"], "code", 0)},
+            "hash": _hx(tmhash(tx)),
+            "height": str(msg.data["height"]),
+        }
+    except (ErrTxInCache, ErrMempoolFull, ValueError) as e:
+        return {"check_tx": {"code": 1, "log": str(e)}, "hash": _hx(tmhash(tx))}
+    finally:
+        env.event_bus.unsubscribe_all(f"btc-{tmhash(tx).hex()[:8]}")
+
+
+def unconfirmed_txs(env, params):
+    txs = env.mempool.reap_max_bytes_max_gas() if env.mempool else []
+    return {
+        "n_txs": str(len(txs)),
+        "total": str(env.mempool.size() if env.mempool else 0),
+        "txs": [_hx(t) for t in txs[: int(params.get("limit", 30))]],
+    }
+
+
+def num_unconfirmed_txs(env, params):
+    return {
+        "n_txs": str(env.mempool.size() if env.mempool else 0),
+        "total_bytes": str(env.mempool.total_bytes() if env.mempool else 0),
+    }
+
+
+def tx(env, params):
+    h = bytes.fromhex(params["hash"])
+    rec = env.tx_indexer.get(h) if env.tx_indexer else None
+    if rec is None:
+        raise RPCError(-32603, "tx not found")
+    return {
+        "hash": _hx(h),
+        "height": str(rec["height"]),
+        "index": rec["index"],
+        "tx_result": {"code": rec["code"], "data": _hx(rec["data"])},
+        "tx": _hx(rec["tx"]),
+    }
+
+
+def tx_search(env, params):
+    query = params.get("query", "")
+    recs = env.tx_indexer.search(query) if env.tx_indexer else []
+    return {
+        "txs": [
+            {
+                "hash": _hx(tmhash(r["tx"])),
+                "height": str(r["height"]),
+                "index": r["index"],
+                "tx_result": {"code": r["code"]},
+            }
+            for r in recs
+        ],
+        "total_count": str(len(recs)),
+    }
+
+
+def block_search(env, params):
+    query = params.get("query", "")
+    heights = env.block_indexer.search(query) if env.block_indexer else []
+    out = []
+    for h in heights:
+        blk = env.block_store.load_block(h)
+        if blk is not None:
+            out.append({"block_id": {"hash": _hx(blk.hash())},
+                        "block": _block_json(blk)})
+    return {"blocks": out, "total_count": str(len(out))}
+
+
+ROUTES = {
+    "health": health,
+    "status": status,
+    "abci_info": abci_info,
+    "abci_query": abci_query,
+    "block": block,
+    "block_by_hash": block_by_hash,
+    "header": header,
+    "commit": commit,
+    "block_results": block_results,
+    "validators": validators,
+    "genesis": genesis,
+    "net_info": net_info,
+    "consensus_state": consensus_state,
+    "consensus_params": consensus_params,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_async": broadcast_tx_async,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "tx": tx,
+    "tx_search": tx_search,
+    "block_search": block_search,
+}

@@ -160,13 +160,15 @@ def build_last_commit_info(block: Block, last_vals: ValidatorSet | None):
 
 class BlockExecutor:
     def __init__(self, app_conns, state_store=None, block_store=None,
-                 backend: str = "tpu", mempool=None, evidence_pool=None):
+                 backend: str = "tpu", mempool=None, evidence_pool=None,
+                 event_bus=None):
         self.app = app_conns
         self.state_store = state_store
         self.block_store = block_store
         self.backend = backend
         self.mempool = mempool
         self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
         self.event_handlers: list = []
 
     # --- proposal side ---
@@ -286,6 +288,17 @@ class BlockExecutor:
             self.state_store.save_finalize_response(
                 block.header.height, results_hash(resp.tx_results)
             )
+        if self.event_bus is not None:
+            # fire events (reference execution.go:313 fireEvents)
+            self.event_bus.publish_new_block(block, resp)
+            for i, tx in enumerate(block.data.txs):
+                self.event_bus.publish_tx(
+                    block.header.height, i, tx, resp.tx_results[i]
+                )
+            if resp.validator_updates:
+                self.event_bus.publish_validator_set_updates(
+                    resp.validator_updates
+                )
         for handler in self.event_handlers:
             handler(block, resp)
         return new_state

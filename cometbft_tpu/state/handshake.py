@@ -39,7 +39,12 @@ class Handshaker:
         app_height = info.last_block_height
         app_hash = info.last_block_app_hash
 
-        state = self.state_store.load() or self.genesis_state.copy()
+        state = self.state_store.load()
+        if state is None:
+            # bootstrap: persist genesis validators for heights 1 and 2
+            # (reference internal/state/store.go Bootstrap)
+            state = self.genesis_state.copy()
+            self.state_store.save(state)
 
         if app_height == 0:
             # fresh app: InitChain with the genesis validator set

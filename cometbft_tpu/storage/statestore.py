@@ -33,23 +33,21 @@ class StateStore:
     def save(self, state) -> None:
         from ..state.types import encode_validator_set
 
+        # `validators` is the set for the NEXT height to commit; at genesis
+        # (last_block_height == 0) that is initial_height, not 1 (reference
+        # internal/state/store.go Bootstrap vs save split).
+        next_height = max(state.last_block_height + 1, state.initial_height)
         sets = [(_KEY_STATE, state.encode())]
         if state.next_validators is not None:
-            # validators for height H were saved when H-1 committed; on each
-            # save we record next_validators at last_height+2 like the
-            # reference's bootstrap/save split
             sets.append(
                 (
-                    _key_vals(state.last_block_height + 2),
+                    _key_vals(next_height + 1),
                     encode_validator_set(state.next_validators),
                 )
             )
         if state.validators is not None:
             sets.append(
-                (
-                    _key_vals(state.last_block_height + 1),
-                    encode_validator_set(state.validators),
-                )
+                (_key_vals(next_height), encode_validator_set(state.validators))
             )
         self._db.write_batch(sets)
 

@@ -1,0 +1,111 @@
+"""Genesis document (reference types/genesis.go).
+
+The chain's immutable boot config: chain id, genesis time, initial
+validator set, consensus params, app state. JSON on disk like the
+reference (genesis.json).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ..crypto.ed25519 import Ed25519PubKey
+from .basic import Timestamp
+from .validator_set import Validator, ValidatorSet
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    pub_key_bytes: bytes
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time: Timestamp = field(default_factory=Timestamp)
+    initial_height: int = 1
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b""
+
+    def validate_basic(self) -> None:
+        """reference types/genesis.go ValidateAndComplete."""
+        if not self.chain_id:
+            raise ValueError("genesis: empty chain id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"genesis: chain id longer than {MAX_CHAIN_ID_LEN}")
+        if self.initial_height < 1:
+            raise ValueError("genesis: initial_height must be >= 1")
+        for gv in self.validators:
+            if gv.power < 0:
+                raise ValueError("genesis: negative validator power")
+            if len(gv.pub_key_bytes) != 32:
+                raise ValueError("genesis: bad ed25519 pubkey size")
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet(
+            [
+                Validator.from_pub_key(Ed25519PubKey(gv.pub_key_bytes), gv.power)
+                for gv in self.validators
+            ]
+        )
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "genesis_time": {
+                    "seconds": self.genesis_time.seconds,
+                    "nanos": self.genesis_time.nanos,
+                },
+                "initial_height": self.initial_height,
+                "validators": [
+                    {
+                        "pub_key": gv.pub_key_bytes.hex(),
+                        "power": gv.power,
+                        "name": gv.name,
+                    }
+                    for gv in self.validators
+                ],
+                "app_hash": self.app_hash.hex(),
+                "app_state": self.app_state.hex(),
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "GenesisDoc":
+        d = json.loads(raw)
+        gd = cls(
+            chain_id=d["chain_id"],
+            genesis_time=Timestamp(
+                d.get("genesis_time", {}).get("seconds", 0),
+                d.get("genesis_time", {}).get("nanos", 0),
+            ),
+            initial_height=d.get("initial_height", 1),
+            validators=[
+                GenesisValidator(
+                    bytes.fromhex(v["pub_key"]), v["power"], v.get("name", "")
+                )
+                for v in d.get("validators", [])
+            ],
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            app_state=bytes.fromhex(d.get("app_state", "")),
+        )
+        gd.validate_basic()
+        return gd
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

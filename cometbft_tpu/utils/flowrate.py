@@ -1,0 +1,57 @@
+"""Sliding-window transfer-rate monitor (reference internal/flowrate).
+
+Tracks bytes over a window to expose an average rate and an optional
+limiter (reference flowrate.Monitor/Limit); used by block-sync peer
+scoring and MConnection throttling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self, window_s: float = 10.0, now=None):
+        self._window = window_s
+        self._now = now or time.monotonic
+        self._samples: list[tuple[float, int]] = []
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def update(self, n: int) -> None:
+        t = self._now()
+        with self._lock:
+            self._samples.append((t, n))
+            self._total += n
+            self._trim(t)
+
+    def _trim(self, t: float) -> None:
+        cutoff = t - self._window
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.pop(0)
+
+    def rate(self) -> float:
+        """Bytes/second over the window."""
+        t = self._now()
+        with self._lock:
+            self._trim(t)
+            if not self._samples:
+                return 0.0
+            span = max(t - self._samples[0][0], 1e-9)
+            return sum(n for _, n in self._samples) / span
+
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def limit(self, want: int, rate_limit: float) -> int:
+        """How many of `want` bytes may be sent now to respect rate_limit
+        (0 = wait); simple token calculation over the window."""
+        if rate_limit <= 0:
+            return want
+        current = self.rate()
+        if current >= rate_limit:
+            return 0
+        burst = int((rate_limit - current) * self._window / 4)
+        return max(0, min(want, burst))

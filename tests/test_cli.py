@@ -1,0 +1,51 @@
+"""CLI smoke tests (reference cmd/cometbft/commands tests)."""
+
+import json
+import os
+
+from cometbft_tpu.cli import main
+
+
+def test_init_show_reset(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init", "--chain-id", "cli-chain"]) == 0
+    assert os.path.exists(os.path.join(home, "config/config.toml"))
+    assert os.path.exists(os.path.join(home, "config/genesis.json"))
+    capsys.readouterr()
+
+    assert main(["--home", home, "show-node-id"]) == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40
+
+    assert main(["--home", home, "show-validator"]) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert len(v["pub_key"]) == 64
+
+    # reset keeps keys, zeroes last-sign state
+    assert main(["--home", home, "reset-all"]) == 0
+    st = json.load(open(os.path.join(home, "data/priv_validator_state.json")))
+    assert st["height"] == 0
+
+
+def test_testnet_generation(tmp_path, capsys):
+    out = str(tmp_path / "net")
+    assert main(["testnet", "--v", "3", "--output", out,
+                 "--chain-id", "tn"]) == 0
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    gens = []
+    for i in range(3):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config.load(os.path.join(home, "config/config.toml"))
+        assert cfg.base.moniker == f"node{i}"
+        assert len(cfg.p2p.persistent_peer_list()) == 2
+        gens.append(GenesisDoc.load(os.path.join(home, "config/genesis.json")))
+    assert len({g.validator_set().hash() for g in gens}) == 1
+
+
+def test_gen_commands(capsys):
+    assert main(["gen-node-key"]) == 0
+    assert len(json.loads(capsys.readouterr().out)["id"]) == 40
+    assert main(["gen-validator"]) == 0
+    assert len(json.loads(capsys.readouterr().out)["pub_key"]) == 64

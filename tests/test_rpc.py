@@ -1,0 +1,188 @@
+"""RPC + pubsub + indexer tests (reference rpc/jsonrpc tests, pubsub query
+tests, kv indexer tests)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.utils.pubsub import PubSubServer, Query
+
+
+# ------------------------------------------------------------- query ----
+def test_query_language():
+    q = Query("tm.event = 'NewBlock' AND tx.height > 5")
+    assert q.matches({"tm.event": ["NewBlock"], "tx.height": ["6"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["9"]})
+    assert Query("tx.hash EXISTS").matches({"tx.hash": ["AB"]})
+    assert not Query("tx.hash EXISTS").matches({})
+    assert Query("app.key CONTAINS 'ell'").matches({"app.key": ["hello"]})
+    assert Query("x.y != 'a'").matches({"x.y": ["b"]})
+    with pytest.raises(ValueError):
+        Query("")
+    with pytest.raises(ValueError):
+        Query("tm.event ~ 'x'")
+
+
+def test_pubsub_routing():
+    srv = PubSubServer()
+    sub_blocks = srv.subscribe("c1", "tm.event = 'NewBlock'")
+    sub_all_tx = srv.subscribe("c1", "tm.event = 'Tx' AND tx.height >= 2")
+    srv.publish("blk1", {"tm.event": ["NewBlock"]})
+    srv.publish("tx1", {"tm.event": ["Tx"], "tx.height": ["1"]})
+    srv.publish("tx2", {"tm.event": ["Tx"], "tx.height": ["2"]})
+    assert [m.data for m in sub_blocks.drain()] == ["blk1"]
+    assert [m.data for m in sub_all_tx.drain()] == ["tx2"]
+    srv.unsubscribe_all("c1")
+    srv.publish("blk2", {"tm.event": ["NewBlock"]})
+    assert sub_blocks.drain() == []
+
+
+# --------------------------------------------------------- full node ----
+@pytest.fixture(scope="module")
+def rpc_node(tmp_path_factory):
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import Timestamp
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = str(tmp_path_factory.mktemp("rpcnode"))
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    pv = FilePV.generate(None, None)
+    genesis = GenesisDoc(
+        chain_id="rpc-chain", genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[GenesisValidator(pv.pub_key().bytes(), 10, "v0")],
+    )
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_propose = 0.5
+    cfg.consensus.timeout_commit = 0.05
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        json.dump({
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }, f)
+    node = Node(cfg, app=KVStoreApp())
+    node.start()
+    deadline = time.monotonic() + 30
+    while node.consensus.sm_state.last_block_height < 2:
+        assert time.monotonic() < deadline, "single-node chain stalled"
+        time.sleep(0.1)
+    yield node
+    node.stop()
+
+
+def test_rpc_http_roundtrip(rpc_node):
+    from cometbft_tpu.rpc import HTTPClient
+
+    host, port = rpc_node.rpc_addr
+    c = HTTPClient(f"http://{host}:{port}")
+    assert c.health() == {}
+    st = c.status()
+    assert st["node_info"]["network"] == "rpc-chain"
+    assert int(st["sync_info"]["latest_block_height"]) >= 2
+    blk = c.block(height=1)
+    assert blk["block"]["header"]["height"] == "1"
+    hdr = c.header(height=1)
+    assert hdr["header"]["chain_id"] == "rpc-chain"
+    cm = c.commit(height=1)
+    assert cm["signed_header"]["commit"]["height"] == "1"
+    vals = c.validators(height=1)
+    assert vals["count"] == "1"
+    gen = c.genesis()
+    assert gen["genesis"]["chain_id"] == "rpc-chain"
+    ni = c.net_info()
+    assert ni["n_peers"] == "0"
+    cs = c.consensus_state()
+    assert int(cs["round_state"]["height"]) >= 2
+    ai = c.abci_info()
+    assert int(ai["response"]["last_block_height"]) >= 1
+    # URI style GET
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{host}:{port}/health") as resp:
+        out = json.loads(resp.read())
+    assert out["result"] == {}
+
+
+def test_rpc_broadcast_and_tx_search(rpc_node):
+    from cometbft_tpu.rpc import HTTPClient
+
+    host, port = rpc_node.rpc_addr
+    c = HTTPClient(f"http://{host}:{port}")
+    tx = b"rpc-test=42"
+    res = c.broadcast_tx_commit(tx=tx.hex())
+    assert res["tx_result"]["code"] == 0
+    height = int(res["height"])
+    # indexer catches up async
+    deadline = time.monotonic() + 10
+    rec = None
+    while time.monotonic() < deadline:
+        try:
+            rec = c.tx(hash=res["hash"].lower())
+            break
+        except RuntimeError:
+            time.sleep(0.1)
+    assert rec is not None and int(rec["height"]) == height
+    found = c.tx_search(query=f"tx.height = {height}")
+    assert int(found["total_count"]) >= 1
+    # abci query sees the key
+    q = c.abci_query(path="/store", data=b"rpc-test".hex())
+    assert bytes.fromhex(q["response"]["value"]) == b"42"
+
+
+def test_rpc_websocket_subscribe(rpc_node):
+    import base64
+    import socket
+
+    host, port = rpc_node.rpc_addr
+    s = socket.create_connection((host, port), timeout=10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall(
+        f"GET /websocket HTTP/1.1\r\nHost: {host}\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        f"Sec-WebSocket-Version: 13\r\n\r\n".encode()
+    )
+    resp = s.recv(4096)
+    assert b"101" in resp.split(b"\r\n")[0]
+
+    def send_text(payload: str):
+        data = payload.encode()
+        mask = os.urandom(4)
+        frame = bytearray([0x81, 0x80 | len(data)])
+        frame += mask
+        frame += bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        s.sendall(frame)
+
+    def read_text():
+        head = s.recv(2)
+        n = head[1] & 0x7F
+        if n == 126:
+            import struct as st
+
+            n = st.unpack(">H", s.recv(2))[0]
+        buf = b""
+        while len(buf) < n:
+            buf += s.recv(n - len(buf))
+        return json.loads(buf)
+
+    send_text(json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+        "params": {"query": "tm.event = 'NewBlock'"},
+    }))
+    ack = read_text()
+    assert ack["id"] == 1 and "result" in ack
+    s.settimeout(20)
+    evt = read_text()
+    assert evt["result"]["data"]["type"] == "NewBlock"
+    s.close()
