@@ -38,6 +38,29 @@ D2_C = F.const(_D2_INT)
 SQRT_M1_C = F.const(ref.SQRT_M1)
 ONE_C = F.const(1)
 
+# The same constants as one stacked host array — Pallas kernels cannot
+# close over array constants, so fused kernels take this as an operand:
+# rows [0:22)=2d, [22:44)=d, [44:66)=sqrt(-1).
+_CONSTS_NP = np.concatenate(
+    [F.from_int(_D2_INT)[:, None], F.from_int(ref.D)[:, None],
+     F.from_int(ref.SQRT_M1)[:, None]], axis=1
+).T.reshape(3 * F.NLIMBS, 1)
+
+# While tracing inside a fused kernel this holds {'d2': (22,1) value, ...}
+# so the shared point-op code below picks up operand-backed constants.
+_KCONSTS: dict | None = None
+
+
+def _kc(name, default):
+    return _KCONSTS[name] if _KCONSTS is not None else default
+
+
+def _row0_const(val: int, rows: int, cols: int):
+    """Field element val*1 (only limb 0 set) synthesized in-kernel via iota
+    — constants that are small integers never need an operand."""
+    r = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+    return jnp.where(r == 0, val, 0)
+
 
 def identity(batch: int):
     z = jnp.zeros((F.NLIMBS, batch), jnp.int32)
@@ -51,7 +74,7 @@ def add(p, q):
     X2, Y2, Z2, T2 = q
     a = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
     b = F.mul(F.add(Y1, X1), F.add(Y2, X2))
-    c = F.mul(F.mul(T1, D2_C), T2)
+    c = F.mul(F.mul(T1, _kc("d2", D2_C)), T2)
     d = F.mul(F.add(Z1, Z1), Z2)
     e = F.sub(b, a)
     f = F.sub(d, c)
@@ -63,7 +86,7 @@ def add(p, q):
 def to_niels(p):
     """Extended -> cached niels form (Y+X, Y-X, 2dT, 2Z). 1 mul."""
     X, Y, Z, T = p
-    return (F.add(Y, X), F.sub(Y, X), F.mul(T, D2_C), F.add(Z, Z))
+    return (F.add(Y, X), F.sub(Y, X), F.mul(T, _kc("d2", D2_C)), F.add(Z, Z))
 
 
 def add_niels(p, n):
@@ -139,6 +162,73 @@ def eq_points(p, q):
     return F.eq(F.mul(X1, Z2), F.mul(X2, Z1)) & F.eq(F.mul(Y1, Z2), F.mul(Y2, Z1))
 
 
+def _abs_diff_zero(a, b):
+    """(1, B) int32 mask: canonical(a) == canonical(b). Kernel-safe
+    keepdims formulation (no reductions to 1-D shapes)."""
+    d = jnp.abs(F.freeze(a) - F.freeze(b))
+    return (jnp.sum(d, axis=0, keepdims=True) == 0).astype(jnp.int32)
+
+
+def _decompress_kernel(y_ref, sign_ref, bias_ref, consts_ref,
+                       valid_o, x_o, t_o, scratch):
+    """Fused ZIP-215 decompression (sqrt candidate + checks): ~280 field
+    muls in one launch. y arrives as limbs (byte unpacking is mul-free at
+    the XLA level); outputs x, t = x*y and the validity mask."""
+    nl = F.NLIMBS
+    with F.kernel_mode(scratch, bias_ref[...]):
+        y = y_ref[...]
+        batch = y.shape[1]
+        d_c = consts_ref[nl : 2 * nl, :]
+        sqrtm1 = consts_ref[2 * nl : 3 * nl, :]
+        one = _row0_const(1, nl, batch)
+        yy = F.sq(y)
+        u = F.sub(yy, one)
+        v = F.add(F.mul(yy, d_c), one)
+        v3 = F.mul(F.sq(v), v)
+        v7 = F.mul(F.sq(v3), v)
+        x = F.mul(F.mul(u, v3), F.pow2523(F.mul(u, v7)))
+        vxx = F.mul(v, F.sq(x))
+        ok_direct = _abs_diff_zero(vxx, u)
+        ok_flip = _abs_diff_zero(vxx, F.neg(u))
+        x = jnp.where(ok_flip != 0, F.mul(x, sqrtm1), x)
+        valid = ok_direct | ok_flip
+        par = F.freeze(x)[0:1] & 1
+        x = jnp.where(par != sign_ref[...], F.neg(x), x)
+        t = F.mul(x, y)
+    valid_o[...] = valid
+    x_o[...] = x
+    t_o[...] = t
+
+
+def _decompress_pallas(y, sign):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch = y.shape[1]
+    tile = min(batch, F._PALLAS_TILE)
+    nl = F.NLIMBS
+    point_spec = pl.BlockSpec((nl, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    bias_spec = pl.BlockSpec((nl, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    consts_spec = pl.BlockSpec(
+        (3 * nl, 1), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    valid, x, t = pl.pallas_call(
+        _decompress_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, batch), jnp.int32),
+            jax.ShapeDtypeStruct((nl, batch), jnp.int32),
+            jax.ShapeDtypeStruct((nl, batch), jnp.int32),
+        ],
+        grid=(batch // tile,),
+        in_specs=[point_spec, row_spec, bias_spec, consts_spec],
+        out_specs=[row_spec, point_spec, point_spec],
+        scratch_shapes=[pltpu.VMEM((F._WIDE, tile), jnp.int32)],
+    )(y, sign[None, :], jnp.asarray(F._SUB_BIAS), jnp.asarray(_CONSTS_NP))
+    return valid[0] != 0, x, t
+
+
 def decompress(b):
     """ZIP-215 liberal point decoding.
 
@@ -151,6 +241,10 @@ def decompress(b):
     sign = (b[:, 31].astype(jnp.int32) >> 7) & 1  # (B,)
     masked = b.at[:, 31].set(b[:, 31] & 0x7F)
     y = F.from_bytes_le(masked)  # < 2^255, loose
+    one = jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], y.shape)
+    if F._use_pallas(y):
+        valid, x, t = _decompress_pallas(y, sign)
+        return valid, (x, y, one, t)
     yy = F.sq(y)
     u = F.sub(yy, ONE_C)
     v = F.add(F.mul(yy, D_C), ONE_C)
@@ -164,7 +258,7 @@ def decompress(b):
     valid = ok_direct | ok_flip
     flip_sign = F.parity(x) != sign
     x = F.select(flip_sign, F.neg(x), x)
-    return valid, (x, y, jnp.broadcast_to(jnp.asarray(F.from_int(1))[:, None], y.shape), F.mul(x, y))
+    return valid, (x, y, one, F.mul(x, y))
 
 
 def compress(p):
@@ -240,16 +334,22 @@ def _apply_sign_affine(sign_row, ypx, ymx, t2d):
     )
 
 
-def _base_madd(r, ws_row):
-    """madd of [digit]B from the constant base table (signed select)."""
+def _base_madd(r, ws_row, base_rows=None):
+    """madd of [digit]B from the constant base table (signed select).
+
+    base_rows: callable(entry, comp) -> (22, 1-or-B) row; defaults to the
+    module-level table (XLA path). Kernels pass a VMEM-ref view instead —
+    pallas_call rejects captured array constants.
+    """
+    if base_rows is None:
+        base_rows = lambda e, c: BASE_NIELS[e, c][:, None]
     ypx, ymx, t2d = _select_rows(
-        lambda e, c: BASE_NIELS[e, c][:, None], 3, jnp.abs(ws_row),
-        ws_row.shape[1],
+        base_rows, 3, jnp.abs(ws_row), ws_row.shape[1]
     )
     return madd(r, _apply_sign_affine(ws_row < 0, ypx, ymx, t2d))
 
 
-def _window_step(r, tbl_rows, ws_row, wk_row):
+def _window_step(r, tbl_rows, ws_row, wk_row, base_rows=None):
     """One radix-16 window: 4 doublings + base madd + lane add.
 
     r: extended point of (22, B) arrays; tbl_rows: callable(entry, comp)
@@ -261,7 +361,7 @@ def _window_step(r, tbl_rows, ws_row, wk_row):
     r = dbl_no_t(r)
     r = dbl_no_t(r)
     r = dbl(r)
-    r = _base_madd(r, ws_row)
+    r = _base_madd(r, ws_row, base_rows)
     # lane-table niels add (4th component z2 carries no sign)
     lypx, lymx, lt2d, lz2 = _select_rows(
         tbl_rows, 4, jnp.abs(wk_row), wk_row.shape[1]
@@ -270,57 +370,125 @@ def _window_step(r, tbl_rows, ws_row, wk_row):
     return add_niels(r, (ypx, ymx, t2d, lz2))
 
 
-def _window_kernel(x_ref, y_ref, z_ref, t_ref_in, tbl_ref, ws_ref, wk_ref,
-                   xo, yo, zo, to, scratch):
-    """Fused Pallas kernel: ONE launch per ladder window (instead of ~80
-    small kernels); all 44 field muls share the VMEM conv scratch."""
-    with F.kernel_mode(scratch):
-        r = (x_ref[...], y_ref[...], z_ref[...], t_ref_in[...])
-        nl = F.NLIMBS
-
-        def tbl_rows(e, c):
-            base = (e * 4 + c) * nl
-            return tbl_ref[base : base + nl, :]
-
-        X, Y, Z, T = _window_step(r, tbl_rows, ws_ref[...], wk_ref[...])
-    xo[...], yo[...], zo[...], to[...] = X, Y, Z, T
+def _kernel_identity(batch: int):
+    """Identity point synthesized in-kernel (no captured constants)."""
+    z = jnp.zeros((F.NLIMBS, batch), jnp.int32)
+    one = _row0_const(1, F.NLIMBS, batch)
+    return (z, one, one, z)
 
 
-def _ladder_pallas(s_digits, k_digits, a_point):
+def _ladder_sub_kernel(ax, ay, az, at, rx, ry, rz, rt, ws_ref, wk_ref,
+                       base_ref, bias_ref, consts_ref, xo, yo, zo,
+                       tbl, scratch):
+    """THE fused Pallas kernel: per tile it builds the 9-entry lane table
+    of A in VMEM, runs all 64 shared-doubling windows (fori_loop — one
+    traced window body), subtracts R and multiplies by the cofactor, all
+    without leaving VMEM. One launch per ladder instead of ~350: on this
+    runtime each pallas launch carries ~0.4 ms of serial overhead, which
+    dominated the round-2 per-window formulation.
+
+    Outputs: X, Y, Z of [8]([s]B + [k]A - R); the identity test runs at
+    the XLA level (freeze has no multiplies).
+    """
+    global _KCONSTS
+    nl = F.NLIMBS
+    with F.kernel_mode(scratch, bias_ref[...]):
+        _KCONSTS = {"d2": consts_ref[0:nl, :]}
+        try:
+            a_pt = (ax[...], ay[...], az[...], at[...])
+            batch = a_pt[0].shape[1]
+
+            # Lane table of [e]A, e in 0..8, niels form, in VMEM scratch.
+            ident_n = (
+                _row0_const(1, nl, batch),
+                _row0_const(1, nl, batch),
+                jnp.zeros((nl, batch), jnp.int32),
+                _row0_const(2, nl, batch),
+            )
+            n1 = to_niels(a_pt)
+            entries = [ident_n, n1]
+            pk = a_pt
+            for _ in range(7):
+                pk = add_niels(pk, n1)
+                entries.append(to_niels(pk))
+            for e, niels in enumerate(entries):
+                for c in range(4):
+                    tbl[(e * 4 + c) * nl : (e * 4 + c + 1) * nl, :] = niels[c]
+
+            def tbl_rows(e, c):
+                base = (e * 4 + c) * nl
+                return tbl[base : base + nl, :]
+
+            def base_rows(e, c):
+                base = (e * 3 + c) * nl
+                return base_ref[base : base + nl, :]
+
+            def body(i, r):
+                w = 63 - i
+                ws = ws_ref[pl_dslice(w, 1), :]
+                wk = wk_ref[pl_dslice(w, 1), :]
+                return _window_step(r, tbl_rows, ws, wk, base_rows)
+
+            r = lax.fori_loop(0, 64, body, _kernel_identity(batch))
+            r = add(r, neg((rx[...], ry[...], rz[...], rt[...])))
+            for _ in range(3):
+                r = dbl_no_t(r)
+                r = (r[0], r[1], r[2], None)
+        finally:
+            _KCONSTS = None
+    xo[...], yo[...], zo[...] = r[0], r[1], r[2]
+
+
+pl_dslice = None  # bound lazily (pallas import is TPU-path-only)
+
+
+def _ladder_sub_mul8_pallas(s_digits, k_digits, a_point, r_point):
+    global pl_dslice
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    pl_dslice = pl.dslice
     batch = s_digits.shape[1]
-    tbl = lane_table(a_point)  # (9, 4, 22, B)
-    tbl_flat = tbl.reshape(9 * 4 * F.NLIMBS, batch)
     tile = min(batch, F._PALLAS_TILE)
     nl = F.NLIMBS
+    base_flat = jnp.asarray(BASE_NIELS).reshape(9 * 3 * nl, 1)
+    bias = jnp.asarray(F._SUB_BIAS)
+    consts = jnp.asarray(_CONSTS_NP)
 
     point_spec = pl.BlockSpec((nl, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
-    tbl_spec = pl.BlockSpec(
-        (9 * 4 * nl, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+    dig_spec = pl.BlockSpec((64, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    base_spec = pl.BlockSpec(
+        (9 * 3 * nl, 1), lambda i: (0, 0), memory_space=pltpu.VMEM
     )
-    dig_spec = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
-    call = pl.pallas_call(
-        _window_kernel,
-        out_shape=[jax.ShapeDtypeStruct((nl, batch), jnp.int32)] * 4,
+    bias_spec = pl.BlockSpec((nl, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    consts_spec = pl.BlockSpec(
+        (3 * nl, 1), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        _ladder_sub_kernel,
+        out_shape=[jax.ShapeDtypeStruct((nl, batch), jnp.int32)] * 3,
         grid=(batch // tile,),
-        in_specs=[point_spec] * 4 + [tbl_spec, dig_spec, dig_spec],
-        out_specs=[point_spec] * 4,
-        scratch_shapes=[pltpu.VMEM((F._WIDE, tile), jnp.int32)],
-    )
+        in_specs=[point_spec] * 8 + [dig_spec, dig_spec, base_spec,
+                                     bias_spec, consts_spec],
+        out_specs=[point_spec] * 3,
+        scratch_shapes=[
+            pltpu.VMEM((9 * 4 * nl, tile), jnp.int32),
+            pltpu.VMEM((F._WIDE, tile), jnp.int32),
+        ],
+    )(*a_point, *r_point, s_digits, k_digits, base_flat, bias, consts)
+    return tuple(out)
 
-    xs = (jnp.flip(s_digits, axis=0), jnp.flip(k_digits, axis=0))
 
-    def body(r, w):
-        ws, wk = w
-        out = call(r[0], r[1], r[2], r[3], tbl_flat,
-                   ws[None, :], wk[None, :])
-        return tuple(out), None
-
-    r, _ = lax.scan(body, tuple(identity(batch)), xs)
-    return r
+def ladder_sub_mul8(s_digits, k_digits, a_point, r_point):
+    """(X, Y, Z) of [8]([s]B + [k]a_point - r_point) — the whole ZIP-215
+    verification equation left side. On TPU this is ONE fused kernel."""
+    if F._use_pallas(s_digits):
+        return _ladder_sub_mul8_pallas(s_digits, k_digits, a_point, r_point)
+    r = ladder(s_digits, k_digits, a_point)
+    r = add(r, neg(r_point))
+    m = mul8(r)
+    return (m[0], m[1], m[2])
 
 
 def ladder(s_digits, k_digits, a_point):
@@ -328,12 +496,10 @@ def ladder(s_digits, k_digits, a_point):
 
     s_digits, k_digits: (64, B) int32 in [-8, 7], little-endian (digit i
     weighs 16^i) — from ops.scalar.recode_signed. a_point: batched extended
-    point. Scans digits from most to least significant; on TPU each window
-    is ONE fused Pallas kernel launch. No data-dependent control flow.
+    point. Scans digits from most to least significant. XLA value-form
+    (the TPU path runs the fused kernel via ladder_sub_mul8 instead).
     """
     batch = s_digits.shape[1]
-    if F._use_pallas(s_digits):
-        return _ladder_pallas(s_digits, k_digits, a_point)
     tbl = lane_table(a_point)
     xs = (jnp.flip(s_digits, axis=0), jnp.flip(k_digits, axis=0))
 

@@ -56,9 +56,8 @@ def verify_batch(a_bytes, r_bytes, s_bytes, msg_words, two_blocks, live):
 
     ok_a, a_pt = C.decompress(a_bytes)
     ok_r, r_pt = C.decompress(r_bytes)
-    acc = C.ladder(s_digits, k_digits, C.neg(a_pt))
-    acc = C.add(acc, C.neg(r_pt))
-    ok_eq = C.is_identity(C.mul8(acc))
+    X, Y, Z = C.ladder_sub_mul8(s_digits, k_digits, C.neg(a_pt), r_pt)
+    ok_eq = F.is_zero(X) & F.eq(Y, Z)
     return ok_a & ok_r & ok_eq & s_ok & live
 
 

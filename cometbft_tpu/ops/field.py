@@ -110,12 +110,24 @@ def add(a, b):
 _SUB_BIAS = jnp.asarray((2048 * P_LIMBS.astype(np.int64)).astype(np.int32)[:, None])
 
 
+def _bias():
+    return _KERNEL_BIAS if _KERNEL_BIAS is not None else _SUB_BIAS
+
+
+def _p_const():
+    """P_LIMBS as a (22, 1) value; inside kernels it is derived from the
+    bias operand (= 2048 * P_LIMBS) since constants cannot be captured."""
+    if _KERNEL_BIAS is not None:
+        return _KERNEL_BIAS >> 11
+    return jnp.asarray(P_LIMBS[:, None])
+
+
 def sub(a, b):
-    return carry(a - b + _SUB_BIAS)
+    return carry(a - b + _bias())
 
 
 def neg(a):
-    return carry(_SUB_BIAS - a)
+    return carry(_bias() - a)
 
 
 _WIDE = 2 * NLIMBS + 1  # 45 rows; row 44 stays zero (max degree 42)
@@ -176,26 +188,32 @@ def _conv_into_scratch(a, b, t_ref):
 
 # --- kernel context: lets the shared curve/scalar code run INSIDE a fused
 # Pallas kernel. When set (trace time only), mul/sq use the kernel's conv
-# scratch ref instead of nesting pallas_call (which is illegal).
+# scratch ref instead of nesting pallas_call (which is illegal), and
+# sub/neg use a bias value passed in as a kernel input (pallas_call
+# rejects captured array constants, so _SUB_BIAS cannot be closed over).
 _KERNEL_SCRATCH = None
+_KERNEL_BIAS = None
 
 
 class kernel_mode:
     """Context manager marking that field ops are being traced inside a
-    Pallas kernel body, with `scratch` as the shared (45, Bt) conv ref."""
+    Pallas kernel body, with `scratch` as the shared (45, Bt) conv ref and
+    `sub_bias` the in-kernel value of _SUB_BIAS (from a (22, Bt) ref)."""
 
-    def __init__(self, scratch):
+    def __init__(self, scratch, sub_bias=None):
         self.scratch = scratch
+        self.sub_bias = sub_bias
 
     def __enter__(self):
-        global _KERNEL_SCRATCH
-        self._prev = _KERNEL_SCRATCH
+        global _KERNEL_SCRATCH, _KERNEL_BIAS
+        self._prev = (_KERNEL_SCRATCH, _KERNEL_BIAS)
         _KERNEL_SCRATCH = self.scratch
+        _KERNEL_BIAS = self.sub_bias
         return self
 
     def __exit__(self, *exc):
-        global _KERNEL_SCRATCH
-        _KERNEL_SCRATCH = self._prev
+        global _KERNEL_SCRATCH, _KERNEL_BIAS
+        _KERNEL_SCRATCH, _KERNEL_BIAS = self._prev
         return False
 
 
@@ -318,7 +336,7 @@ def freeze(a):
     a = _edit_row0(a, 19 * top)
     a, _ = _seq_pass(a)  # value now < 2^255 + eps < 2p
     # Conditional subtract p.
-    d = a - jnp.asarray(P_LIMBS[:, None])
+    d = a - _p_const()
     d, c = _seq_pass(d)
     nonneg = c == 0  # borrow-free => a >= p
     return jnp.where(nonneg, d, a)

@@ -148,3 +148,30 @@ def test_priv_key_roundtrip():
     pk2 = Ed25519PrivKey(pk.bytes())
     assert pk2.pub_key().bytes() == pk.pub_key().bytes()
     assert len(pk.pub_key().address()) == 20
+
+
+def test_pipelined_submit_and_collect():
+    """submit() snapshots per-batch state: reusing/mutating the verifier
+    after submit must not corrupt in-flight results, and collect_pending
+    fetches many batches with one transfer."""
+    from cometbft_tpu.crypto.ed25519 import collect_pending
+
+    items = _signed(5)
+    bv = Ed25519BatchVerifier(backend="tpu")
+    for pub, msg, sig in items[:3]:
+        bv.add(Ed25519PubKey(pub), msg, sig)
+    p1 = bv.submit()
+    # mutate after submit: add an oversize message (host-fallback lane)
+    # and a corrupted signature, then submit again
+    big = bytes(rng.bytes(500))
+    seed = bytes(rng.bytes(32))
+    bv.add(Ed25519PubKey(ref.pubkey_from_seed(seed)), big, ref.sign(seed, big))
+    pub4, msg4, sig4 = items[3]
+    bv.add(Ed25519PubKey(pub4), msg4 + b"!", sig4)
+    p2 = bv.submit()
+    (ok1, bits1), (ok2, bits2) = collect_pending([p1, p2])
+    assert ok1 and bits1 == [True, True, True]
+    assert not ok2 and bits2 == [True, True, True, True, False]
+    # individual result() agrees with collect_pending
+    ok1b, bits1b = p1.result()
+    assert (ok1b, bits1b) == (ok1, bits1)

@@ -26,9 +26,9 @@ bits2 = np.asarray(jax.block_until_ready(jfn(*args)))
 assert bits1.all(), "valid batch must verify on TPU"
 assert (bits1 == bits2).all(), "kernel must be deterministic"
 # corrupt one signature lane -> exactly that lane flips
-a, r, s_wins, k_wins, live = args
+a, r, s_raw, words, two_blocks, live = args
 r_bad = r.copy(); r_bad[7] ^= 0xFF
-bits3 = np.asarray(jax.block_until_ready(jfn(a, r_bad, s_wins, k_wins, live)))
+bits3 = np.asarray(jax.block_until_ready(jfn(a, r_bad, s_raw, words, two_blocks, live)))
 assert not bits3[7], "corrupted lane must fail"
 assert bits3[:7].all() and bits3[8:].all(), "other lanes unaffected"
 print("tpu-smoke-ok")
