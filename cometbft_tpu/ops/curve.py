@@ -170,12 +170,12 @@ def _abs_diff_zero(a, b):
 
 
 def _decompress_kernel(y_ref, sign_ref, bias_ref, consts_ref,
-                       valid_o, x_o, t_o, scratch):
+                       valid_o, x_o, t_o):
     """Fused ZIP-215 decompression (sqrt candidate + checks): ~280 field
     muls in one launch. y arrives as limbs (byte unpacking is mul-free at
     the XLA level); outputs x, t = x*y and the validity mask."""
     nl = F.NLIMBS
-    with F.kernel_mode(scratch, bias_ref[...]):
+    with F.kernel_mode(bias_ref[...]):
         y = y_ref[...]
         batch = y.shape[1]
         d_c = consts_ref[nl : 2 * nl, :]
@@ -224,7 +224,6 @@ def _decompress_pallas(y, sign):
         grid=(batch // tile,),
         in_specs=[point_spec, row_spec, bias_spec, consts_spec],
         out_specs=[row_spec, point_spec, point_spec],
-        scratch_shapes=[pltpu.VMEM((F._WIDE, tile), jnp.int32)],
     )(y, sign[None, :], jnp.asarray(F._SUB_BIAS), jnp.asarray(_CONSTS_NP))
     return valid[0] != 0, x, t
 
@@ -378,8 +377,7 @@ def _kernel_identity(batch: int):
 
 
 def _ladder_sub_kernel(ax, ay, az, at, rx, ry, rz, rt, ws_ref, wk_ref,
-                       base_ref, bias_ref, consts_ref, xo, yo, zo,
-                       tbl, scratch):
+                       base_ref, bias_ref, consts_ref, xo, yo, zo, tbl):
     """THE fused Pallas kernel: per tile it builds the 9-entry lane table
     of A in VMEM, runs all 64 shared-doubling windows (fori_loop — one
     traced window body), subtracts R and multiplies by the cofactor, all
@@ -392,7 +390,7 @@ def _ladder_sub_kernel(ax, ay, az, at, rx, ry, rz, rt, ws_ref, wk_ref,
     """
     global _KCONSTS
     nl = F.NLIMBS
-    with F.kernel_mode(scratch, bias_ref[...]):
+    with F.kernel_mode(bias_ref[...]):
         _KCONSTS = {"d2": consts_ref[0:nl, :]}
         try:
             a_pt = (ax[...], ay[...], az[...], at[...])
@@ -472,10 +470,7 @@ def _ladder_sub_mul8_pallas(s_digits, k_digits, a_point, r_point):
         in_specs=[point_spec] * 8 + [dig_spec, dig_spec, base_spec,
                                      bias_spec, consts_spec],
         out_specs=[point_spec] * 3,
-        scratch_shapes=[
-            pltpu.VMEM((9 * 4 * nl, tile), jnp.int32),
-            pltpu.VMEM((F._WIDE, tile), jnp.int32),
-        ],
+        scratch_shapes=[pltpu.VMEM((9 * 4 * nl, tile), jnp.int32)],
     )(*a_point, *r_point, s_digits, k_digits, base_flat, bias, consts)
     return tuple(out)
 

@@ -6,9 +6,9 @@ the carry-fold constant FOLD.
 
 Loose invariant (what every op returns and accepts):
     limb 0   in [0, 13824)   (absorbs carry folds; < 2^13.76)
-    limbs 1+ in [0, 4200)    (~canonical 2^12 plus ripple slack)
+    limbs 1+ in [0, 4300)    (~canonical 2^12 plus ripple slack)
 Schoolbook products then sum to at most
-    2 * 13823 * 4199 + 20 * 4199^2 < 2^29  « int32,
+    2 * 13823 * 4299 + 20 * 4299^2 < 2^28.9  « int32,
 so multiplication never overflows.
 
 Carries are *parallel rounds*, not sequential chains: one round masks every
@@ -134,21 +134,37 @@ _WIDE = 2 * NLIMBS + 1  # 45 rows; row 44 stays zero (max degree 42)
 
 
 def _fold_wide(t):
-    """(45, B) wide product -> loose (22, B).
+    """(45, B) wide product -> loose (22, B), in 4 carry-shift rounds.
 
-    Two unfolded rounds bring every limb under 2^12 + 2^5 (top carry is
-    zero: value < 2^530 < 2^540). The upper limbs then fold into the lower
-    22 (limb 44, <= 4, folds straight to limb 0 with FOLD^2), leaving
-    limbs < 2^28.7, and three folded rounds restore looseness.
+    Bound walk (conv rows < 2^29; rows 43-44 start at 0 since the max
+    product degree is 42):
+    - round 1 (unfolded): rows <= 4095 + 2^17 < 2^17.05; row 44 stays 0.
+    - collapse: lo = t[:22] + FOLD*t[22:44] <= 2^17.05*(1+FOLD) < 1.32e9,
+      int32-safe.  (b^22 = 2^264 ≡ FOLD mod p.)
+    - round 2 over 23 rows (extra row catches the top carry):
+      rows <= 4095 + (1.32e9 >> 12) < 2^18.3.
+    - split-fold the top row T <= 2^18.3: T*b^22 ≡ FOLD*(T & MASK) at
+      limb 0 (<= 2^25.3) + FOLD*(T >> 12) at limb 1 (<= 2^19.5) — the
+      split keeps both contributions int32 where FOLD*T would overflow.
+    - rounds 3-4 (folded) land the loose invariant: worst case is limb 1
+      <= 4095 + (limb0 <= 4095+2^25.3 >> 12) < 4300.
     """
+    batch = t.shape[1]
     t = _round(t, False)
-    t = _round(t, False)
-    top = (FOLD * FOLD) * t[2 * NLIMBS][None, :]
-    top_padded = jnp.concatenate(
-        [top, jnp.zeros((NLIMBS - 1, t.shape[1]), t.dtype)], axis=0
+    lo = t[:NLIMBS] + FOLD * t[NLIMBS : 2 * NLIMBS]
+    lo = jnp.concatenate([lo, jnp.zeros((1, batch), jnp.int32)], axis=0)
+    lo = _round(lo, False)
+    top = lo[NLIMBS : NLIMBS + 1]
+    x = jnp.concatenate(
+        [
+            lo[0:1] + FOLD * (top & MASK),
+            lo[1:2] + FOLD * (top >> BITS),
+            lo[2:NLIMBS],
+        ],
+        axis=0,
     )
-    lo = t[:NLIMBS] + FOLD * t[NLIMBS : 2 * NLIMBS] + top_padded
-    return carry(lo)
+    x = _round(x, True)
+    return _round(x, True)
 
 
 _PALLAS_TILE = 512
@@ -159,71 +175,64 @@ def _conv_rows_shifted(a, b):
 
     22 full-width multiply-accumulates (each (22, Bt)-shaped, full VPU
     sublane utilization) instead of 484 scalar-row ops — the layout the
-    TPU vector unit wants, and a 20x smaller traced graph. Value-level
-    (jnp) variant for the CPU path.
+    TPU vector unit wants, and a 20x smaller traced graph. Pure value
+    form; runs identically under XLA and inside Pallas kernel bodies
+    (measured faster in-kernel than ref-slice accumulation, whose
+    unaligned sublane read-modify-writes Mosaic lowers poorly).
     """
-    t = jnp.zeros((_WIDE, a.shape[1]), jnp.int32)
+    batch = a.shape[1]
+    t = None
     for i in range(NLIMBS):
         rows = a[i][None, :] * b
-        t = t + jnp.concatenate(
-            [
-                jnp.zeros((i, a.shape[1]), jnp.int32),
-                rows,
-                jnp.zeros((_WIDE - NLIMBS - i, a.shape[1]), jnp.int32),
-            ],
-            axis=0,
-        )
+        segs = []
+        if i:
+            segs.append(jnp.zeros((i, batch), jnp.int32))
+        segs.append(rows)
+        tail = _WIDE - NLIMBS - i
+        if tail:
+            segs.append(jnp.zeros((tail, batch), jnp.int32))
+        shifted = jnp.concatenate(segs, axis=0) if len(segs) > 1 else segs[0]
+        t = shifted if t is None else t + shifted
     return t
 
 
-def _conv_into_scratch(a, b, t_ref):
-    """Accumulate the wide product into a (45, Bt) VMEM scratch ref
-    (Mosaic supports ref-slice accumulate; value-level update slices it
-    does not)."""
-    t_ref[...] = jnp.zeros_like(t_ref)
-    for i in range(NLIMBS):
-        t_ref[i : i + NLIMBS, :] += a[i][None, :] * b
-    return t_ref[...]
-
-
 # --- kernel context: lets the shared curve/scalar code run INSIDE a fused
-# Pallas kernel. When set (trace time only), mul/sq use the kernel's conv
-# scratch ref instead of nesting pallas_call (which is illegal), and
-# sub/neg use a bias value passed in as a kernel input (pallas_call
-# rejects captured array constants, so _SUB_BIAS cannot be closed over).
-_KERNEL_SCRATCH = None
+# Pallas kernel. When set (trace time only), mul/sq know not to nest a
+# pallas_call (which is illegal), and sub/neg use a bias value passed in
+# as a kernel input (pallas_call rejects captured array constants, so
+# _SUB_BIAS cannot be closed over).
+_IN_KERNEL = False
 _KERNEL_BIAS = None
 
 
 class kernel_mode:
     """Context manager marking that field ops are being traced inside a
-    Pallas kernel body, with `scratch` as the shared (45, Bt) conv ref and
-    `sub_bias` the in-kernel value of _SUB_BIAS (from a (22, Bt) ref)."""
+    Pallas kernel body, with `sub_bias` the in-kernel value of _SUB_BIAS
+    (sliced from a (22, 1) operand ref)."""
 
-    def __init__(self, scratch, sub_bias=None):
-        self.scratch = scratch
+    def __init__(self, sub_bias=None):
         self.sub_bias = sub_bias
 
     def __enter__(self):
-        global _KERNEL_SCRATCH, _KERNEL_BIAS
-        self._prev = (_KERNEL_SCRATCH, _KERNEL_BIAS)
-        _KERNEL_SCRATCH = self.scratch
+        global _IN_KERNEL, _KERNEL_BIAS
+        self._prev = (_IN_KERNEL, _KERNEL_BIAS)
+        _IN_KERNEL = True
         _KERNEL_BIAS = self.sub_bias
         return self
 
     def __exit__(self, *exc):
-        global _KERNEL_SCRATCH, _KERNEL_BIAS
-        _KERNEL_SCRATCH, _KERNEL_BIAS = self._prev
+        global _IN_KERNEL, _KERNEL_BIAS
+        _IN_KERNEL, _KERNEL_BIAS = self._prev
         return False
 
 
-def _mul_kernel(a_ref, b_ref, o_ref, t_ref):
-    o_ref[...] = _fold_wide(_conv_into_scratch(a_ref[...], b_ref[...], t_ref))
+def _mul_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = _fold_wide(_conv_rows_shifted(a_ref[...], b_ref[...]))
 
 
-def _sq_kernel(a_ref, o_ref, t_ref):
+def _sq_kernel(a_ref, o_ref):
     a = a_ref[...]
-    o_ref[...] = _fold_wide(_conv_into_scratch(a, a, t_ref))
+    o_ref[...] = _fold_wide(_conv_rows_shifted(a, a))
 
 
 def _use_pallas(*arrs) -> bool:
@@ -249,7 +258,6 @@ def _pallas_binop(kernel, *arrs):
         grid=(b // tile,),
         in_specs=[spec] * len(arrs),
         out_specs=spec,
-        scratch_shapes=[pltpu.VMEM((_WIDE, tile), jnp.int32)],
     )(*arrs)
 
 
@@ -264,16 +272,15 @@ def _bcast(a, b):
 def mul(a, b):
     """Schoolbook 22x22 limb multiply. Loose inputs -> loose output.
 
-    On TPU this is a single Pallas kernel: the whole convolution + carry
-    chain runs in VMEM (one custom-call op in the graph — round 1's
-    einsum formulation was HBM-bound AND blew up XLA compile time).
-    Elsewhere (CPU test mesh) the same math runs as a fused jnp DAG.
+    Inside a fused kernel (kernel_mode) and on the CPU mesh this is a
+    pure jnp DAG; standalone on TPU it becomes one Pallas kernel (round
+    1's einsum formulation was HBM-bound AND blew up XLA compile time).
 
     Product limbs t[k] = sum_{i+j=k} a[i]b[j] < 2^29 (loose bound above).
     """
     a, b = _bcast(jnp.asarray(a), jnp.asarray(b))
-    if _KERNEL_SCRATCH is not None:
-        return _fold_wide(_conv_into_scratch(a, b, _KERNEL_SCRATCH))
+    if _IN_KERNEL:
+        return _fold_wide(_conv_rows_shifted(a, b))
     if _use_pallas(a, b):
         return _pallas_binop(_mul_kernel, a, b)
     return _fold_wide(_conv_rows_shifted(a, b))
@@ -282,8 +289,8 @@ def mul(a, b):
 def sq(a):
     """Squaring: one-input variant of mul (halves HBM reads on TPU)."""
     a = jnp.asarray(a)
-    if _KERNEL_SCRATCH is not None:
-        return _fold_wide(_conv_into_scratch(a, a, _KERNEL_SCRATCH))
+    if _IN_KERNEL:
+        return _fold_wide(_conv_rows_shifted(a, a))
     if _use_pallas(a):
         return _pallas_binop(_sq_kernel, a)
     return _fold_wide(_conv_rows_shifted(a, a))
@@ -367,7 +374,7 @@ def sqn(x, n: int):
         for _ in range(n):
             x = sq(x)
         return x
-    if _KERNEL_SCRATCH is not None:
+    if _IN_KERNEL:
         return lax.fori_loop(0, n, lambda i, v: sq(v), x)
     return lax.scan(lambda c, _: (sq(c), None), x, None, length=n)[0]
 
