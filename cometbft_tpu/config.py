@@ -158,6 +158,17 @@ class Config:
 
     # -- TOML -----------------------------------------------------------
     def to_toml(self) -> str:
+        def esc(s: str) -> str:
+            # TOML basic-string escaping: a moniker or path containing a
+            # quote/backslash must survive a save/load round trip.
+            return (
+                str(s)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+                .replace("\t", "\\t")
+            )
+
         def emit(name, obj):
             lines = [f"[{name}]"]
             for k, v in asdict(obj).items():
@@ -166,7 +177,7 @@ class Config:
                 elif isinstance(v, (int, float)):
                     lines.append(f"{k} = {v}")
                 else:
-                    lines.append(f'{k} = "{v}"')
+                    lines.append(f'{k} = "{esc(v)}"')
             return "\n".join(lines)
 
         parts = [

@@ -131,6 +131,17 @@ class CListMempool:
         for cb in self.on_new_tx:
             cb(tx)
 
+    def reap_max_txs(self, n: int = -1) -> list[bytes]:
+        """First n txs in FIFO order without budget accounting (reference
+        ReapMaxTxs — serves the unconfirmed_txs RPC page cheaply)."""
+        with self._lock:
+            out = []
+            for t in self._txs.values():
+                if 0 <= n <= len(out):
+                    break
+                out.append(t.tx)
+            return out
+
     def reap_max_bytes_max_gas(self, max_bytes: int = -1, max_gas: int = -1
                                ) -> list[bytes]:
         """FIFO reap under byte/gas budgets (reference ReapMaxBytesMaxGas)."""

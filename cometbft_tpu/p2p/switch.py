@@ -76,14 +76,29 @@ class Switch:
         self._accept_thread.start()
 
     def _accept_loop(self) -> None:
+        # The handshake runs on a per-connection thread: a dialer that
+        # connects and goes silent burns its own 10s timeout, not the
+        # accept loop's, so inbound admission never serializes.
         while not self._stopped.is_set():
             try:
-                got = self.transport.accept()
-            except Exception:  # noqa: BLE001 — failed upgrade: keep accepting
+                raw = self.transport.accept_raw()
+            except Exception:  # noqa: BLE001 — listener hiccup: keep going
                 continue
-            if got is None:
+            if raw is None:
                 return
-            self._add_peer(*got, outbound=False)
+            threading.Thread(
+                target=self._upgrade_and_add, args=(raw,), daemon=True
+            ).start()
+
+    def _upgrade_and_add(self, raw) -> None:
+        try:
+            sconn, info = self.transport.upgrade(raw)
+            self._add_peer(sconn, info, outbound=False)
+        except Exception:  # noqa: BLE001 — failed upgrade: drop the conn
+            try:
+                raw.close()
+            except OSError:
+                pass
 
     def dial_peer(self, host: str, port: int) -> Peer:
         sc, info = self.transport.dial(host, port)

@@ -79,8 +79,11 @@ class Transport:
         self.node_info.listen_addr = f"{addr[0]}:{addr[1]}"
         return addr[0], addr[1]
 
-    def accept(self):
-        """Blocking accept -> (SecretConnection, NodeInfo) or None on stop."""
+    def accept_raw(self):
+        """Blocking accept of a raw TCP connection (no handshake), or
+        None on stop. Callers upgrade on their own thread so one slow or
+        silent dialer cannot stall peer admission (the reference upgrades
+        concurrently — p2p/transport.go:410)."""
         while not self._stopped.is_set():
             try:
                 raw, _ = self._listener.accept()
@@ -88,8 +91,21 @@ class Transport:
                 continue
             except OSError:
                 return None
-            return self._upgrade(raw)
+            return raw
         return None
+
+    def accept(self):
+        """Blocking accept -> (SecretConnection, NodeInfo) or None on stop.
+
+        Serial convenience path (tests, simple tools); the Switch uses
+        accept_raw + upgrade on a per-connection thread."""
+        raw = self.accept_raw()
+        if raw is None:
+            return None
+        return self._upgrade(raw)
+
+    def upgrade(self, raw: socket.socket):
+        return self._upgrade(raw)
 
     def dial(self, host: str, port: int):
         raw = socket.create_connection((host, port), timeout=10)

@@ -8,7 +8,7 @@ Bytes are hex-encoded strings; blocks/commits are rendered structurally.
 from __future__ import annotations
 
 from ..crypto.keys import tmhash
-from ..mempool.mempool import ErrMempoolFull, ErrTxInCache
+from ..mempool.mempool import ErrMempoolFull, ErrTxInCache, ErrTxTooLarge
 
 
 class RPCError(Exception):
@@ -167,11 +167,9 @@ def block(env, params):
 
 def block_by_hash(env, params):
     want = bytes.fromhex(params.get("hash", ""))
-    bs = env.block_store
-    for h in range(bs.height(), max(bs.base(), 1) - 1, -1):
-        blk = bs.load_block(h)
-        if blk is not None and blk.hash() == want:
-            return {"block_id": {"hash": _hx(want)}, "block": _block_json(blk)}
+    blk = env.block_store.load_block_by_hash(want)
+    if blk is not None:
+        return {"block_id": {"hash": _hx(want)}, "block": _block_json(blk)}
     raise RPCError(-32603, "block not found")
 
 
@@ -275,7 +273,7 @@ def broadcast_tx_sync(env, params):
     try:
         env.mempool.check_tx(tx)
         code, log = 0, ""
-    except (ErrTxInCache, ErrMempoolFull, ValueError) as e:
+    except (ErrTxInCache, ErrMempoolFull, ErrTxTooLarge, ValueError) as e:
         code, log = 1, str(e)
     return {"code": code, "log": log, "hash": _hx(tmhash(tx))}
 
@@ -307,18 +305,20 @@ def broadcast_tx_commit(env, params, timeout_s: float = 30.0):
             "hash": _hx(tmhash(tx)),
             "height": str(msg.data["height"]),
         }
-    except (ErrTxInCache, ErrMempoolFull, ValueError) as e:
+    except (ErrTxInCache, ErrMempoolFull, ErrTxTooLarge, ValueError) as e:
         return {"check_tx": {"code": 1, "log": str(e)}, "hash": _hx(tmhash(tx))}
     finally:
         env.event_bus.unsubscribe_all(f"btc-{tmhash(tx).hex()[:8]}")
 
 
 def unconfirmed_txs(env, params):
-    txs = env.mempool.reap_max_bytes_max_gas() if env.mempool else []
+    limit = int(params.get("limit", 30))
+    txs = env.mempool.reap_max_txs(limit) if env.mempool else []
     return {
         "n_txs": str(len(txs)),
         "total": str(env.mempool.size() if env.mempool else 0),
-        "txs": [_hx(t) for t in txs[: int(params.get("limit", 30))]],
+        "total_bytes": str(env.mempool.total_bytes() if env.mempool else 0),
+        "txs": [_hx(t) for t in txs],
     }
 
 

@@ -87,9 +87,18 @@ class RPCServer:
                 u = urlparse(self.path)
                 method = u.path.strip("/")
                 params = dict(parse_qsl(u.query))
-                # strip quoting from uri params ("5" or 0xABC styles)
+                # URI params arrive as "5" (quoted) or 0xABC (hex) per the
+                # reference's URI style; normalize both so handlers that
+                # do bytes.fromhex / int() see plain values. The 0x strip
+                # only applies to byte-valued params — a quoted string
+                # legitimately starting with 0x must survive.
                 for k, v in params.items():
-                    params[k] = v.strip('"')
+                    quoted = len(v) >= 2 and v[0] == v[-1] == '"'
+                    v = v.strip('"')
+                    if not quoted and k in ("tx", "hash", "data", "evidence") \
+                            and (v.startswith("0x") or v.startswith("0X")):
+                        v = v[2:]
+                    params[k] = v
                 self._dispatch({"jsonrpc": "2.0", "id": -1, "method": method,
                                 "params": params})
 

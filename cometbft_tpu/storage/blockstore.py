@@ -28,6 +28,10 @@ def _key_seen_commit(h: int) -> bytes:
     return b"SC:" + h.to_bytes(8, "big")
 
 
+def _key_block_hash(block_hash: bytes) -> bytes:
+    return b"BH:" + block_hash
+
+
 _KEY_STATE = b"BS:state"
 
 
@@ -69,6 +73,7 @@ class BlockStore:
             sets = [
                 (_key_block(h), block.encode()),
                 (_key_seen_commit(h), seen_commit.encode()),
+                (_key_block_hash(block.hash()), h.to_bytes(8, "big")),
             ]
             if block.last_commit is not None and h > 1:
                 sets.append((_key_commit(h - 1), block.last_commit.encode()))
@@ -81,6 +86,14 @@ class BlockStore:
     def load_block(self, height: int) -> Block | None:
         raw = self._db.get(_key_block(height))
         return Block.decode(raw) if raw else None
+
+    def load_block_by_hash(self, block_hash: bytes) -> Block | None:
+        """O(1) via the hash→height index written at save time
+        (reference internal/store/store.go LoadBlockByHash)."""
+        raw = self._db.get(_key_block_hash(block_hash))
+        if not raw:
+            return None
+        return self.load_block(int.from_bytes(raw, "big"))
 
     def load_block_commit(self, height: int) -> Commit | None:
         """The canonical commit FOR `height` (stored with block height+1)."""
@@ -102,6 +115,9 @@ class BlockStore:
             deletes = []
             pruned = 0
             for h in range(self._base, retain_height):
+                blk = self.load_block(h)
+                if blk is not None:
+                    deletes.append(_key_block_hash(blk.hash()))
                 deletes += [_key_block(h), _key_commit(h), _key_seen_commit(h)]
                 pruned += 1
             self._base = retain_height

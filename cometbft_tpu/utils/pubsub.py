@@ -104,12 +104,23 @@ class Message:
 class Subscription:
     def __init__(self, query: Query, capacity: int = 256):
         self.query = query
+        self.capacity = capacity
         self._buf: list[Message] = []
         self._cv = threading.Condition()
         self.cancelled = False
 
     def publish(self, msg: Message) -> None:
+        """Buffer a matching message; a subscriber that stops draining is
+        cancelled at capacity (reference pubsub drops slow subscribers
+        rather than buffering unboundedly — internal/pubsub/pubsub.go)."""
         with self._cv:
+            if self.cancelled:
+                return
+            if len(self._buf) >= self.capacity:
+                self.cancelled = True
+                self._buf.clear()
+                self._cv.notify_all()
+                return
             self._buf.append(msg)
             self._cv.notify_all()
 
