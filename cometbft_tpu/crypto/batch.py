@@ -1,0 +1,34 @@
+"""Batch-verifier dispatch by public-key type.
+
+Behavior parity: reference crypto/batch/batch.go:11-35 —
+CreateBatchVerifier maps a key type to its batch verifier (ed25519 and
+sr25519 support batching; secp256k1 does not), and SupportsBatchVerifier
+reports whether a key can take the batch path. Callers fall back to
+per-signature verification when batching is unsupported (reference
+types/validation.go:26-53).
+"""
+
+from __future__ import annotations
+
+from .keys import BatchVerifier, PubKey
+
+
+def create_batch_verifier(pub_key: PubKey, backend: str = "tpu") -> BatchVerifier | None:
+    """A fresh batch verifier for this key's type, or None if the type
+    has no batch support."""
+    from . import ed25519, sr25519
+
+    tag = pub_key.type_tag()
+    if tag == ed25519.KEY_TYPE:
+        return ed25519.Ed25519BatchVerifier(backend=backend)
+    if tag == sr25519.KEY_TYPE:
+        return sr25519.Sr25519BatchVerifier(backend=backend)
+    return None
+
+
+def supports_batch_verifier(pub_key: PubKey | None) -> bool:
+    if pub_key is None:
+        return False
+    from . import ed25519, sr25519
+
+    return pub_key.type_tag() in (ed25519.KEY_TYPE, sr25519.KEY_TYPE)

@@ -1,0 +1,217 @@
+"""Merlin transcripts (STROBE-128 over Keccak-f[1600]), host-side.
+
+The sr25519/schnorrkel signature scheme binds every signature to a
+merlin transcript; the reference gets this from curve25519-voi
+(reference crypto/sr25519/privkey.go:15 NewSigningContext). This is an
+independent implementation from the public specifications:
+
+- Keccak-f[1600]: FIPS 202 permutation (round constants derived from
+  the LFSR definition at import, rotation offsets from the spec).
+- STROBE-128: the STROBE protocol framework instantiated exactly as
+  merlin's embedded "mini STROBE" (rate R = 166, init bytes
+  [1, R+2, 1, 0, 1, 96] ‖ "STROBEv1.0.2", operations meta-AD / AD /
+  PRF / KEY).
+- Transcript: merlin v1.0 framing — append_message(label, m) =
+  meta-AD(label) ‖ meta-AD(le32(len(m)), more) ‖ AD(m);
+  challenge_bytes(label, n) = meta-AD(label) ‖ meta-AD(le32(n), more)
+  ‖ PRF(n).
+
+Verified against merlin's published conformance vector in
+tests/test_sr25519.py (test_merlin_conformance_vector).
+"""
+
+from __future__ import annotations
+
+import struct
+
+_MASK64 = (1 << 64) - 1
+
+# Keccak-f[1600] round constants via the LFSR rc(t) from FIPS 202 §3.2.5.
+def _rc_bits():
+    r = 1
+    while True:
+        yield r & 1
+        r <<= 1
+        if r & 0x100:
+            r ^= 0x171
+
+
+def _round_constants():
+    bits = _rc_bits()
+    consts = []
+    for _ in range(24):
+        rc = 0
+        for j in range(7):
+            if next(bits):
+                rc |= 1 << ((1 << j) - 1)
+        consts.append(rc)
+    return consts
+
+
+_RC = _round_constants()
+assert _RC[0] == 1 and _RC[1] == 0x8082 and _RC[23] == 0x8000000080008008
+
+# rotation offsets r[x][y] per FIPS 202 (x = column, y = row)
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+
+def _rotl(v: int, n: int) -> int:
+    n %= 64
+    return ((v << n) | (v >> (64 - n))) & _MASK64
+
+
+def keccak_f1600(state: bytearray) -> None:
+    """In-place permutation of a 200-byte state (lanes little-endian)."""
+    lanes = list(struct.unpack("<25Q", state))
+    a = [[lanes[x + 5 * y] for y in range(5)] for x in range(5)]
+    for rnd in range(24):
+        # theta
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x][y] ^= d[x]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rotl(a[x][y], _ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y])
+        # iota
+        a[0][0] ^= _RC[rnd]
+    flat = [a[x][y] & _MASK64 for y in range(5) for x in range(5)]
+    state[:] = struct.pack("<25Q", *flat)
+
+
+# -- STROBE-128 (merlin's subset) ------------------------------------------
+
+_R = 166  # STROBE-128/1600 rate in bytes
+_FLAG_I = 1
+_FLAG_A = 1 << 1
+_FLAG_C = 1 << 2
+_FLAG_T = 1 << 3
+_FLAG_M = 1 << 4
+_FLAG_K = 1 << 5
+
+
+class Strobe128:
+    __slots__ = ("state", "pos", "pos_begin", "cur_flags")
+
+    def __init__(self, protocol_label: bytes):
+        st = bytearray(200)
+        st[0:6] = bytes([1, _R + 2, 1, 0, 1, 96])
+        st[6:18] = b"STROBEv1.0.2"
+        keccak_f1600(st)
+        self.state = st
+        self.pos = 0
+        self.pos_begin = 0
+        self.cur_flags = 0
+        self.meta_ad(protocol_label, False)
+
+    def clone(self) -> "Strobe128":
+        c = object.__new__(Strobe128)
+        c.state = bytearray(self.state)
+        c.pos = self.pos
+        c.pos_begin = self.pos_begin
+        c.cur_flags = self.cur_flags
+        return c
+
+    def _run_f(self) -> None:
+        self.state[self.pos] ^= self.pos_begin
+        self.state[self.pos + 1] ^= 0x04
+        self.state[_R + 1] ^= 0x80
+        keccak_f1600(self.state)
+        self.pos = 0
+        self.pos_begin = 0
+
+    def _absorb(self, data: bytes) -> None:
+        for byte in data:
+            self.state[self.pos] ^= byte
+            self.pos += 1
+            if self.pos == _R:
+                self._run_f()
+
+    def _overwrite(self, data: bytes) -> None:
+        for byte in data:
+            self.state[self.pos] = byte
+            self.pos += 1
+            if self.pos == _R:
+                self._run_f()
+
+    def _squeeze(self, n: int) -> bytes:
+        out = bytearray()
+        for _ in range(n):
+            out.append(self.state[self.pos])
+            self.state[self.pos] = 0
+            self.pos += 1
+            if self.pos == _R:
+                self._run_f()
+        return bytes(out)
+
+    def _begin_op(self, flags: int, more: bool) -> None:
+        if more:
+            if flags != self.cur_flags:
+                raise ValueError("STROBE op continuation flag mismatch")
+            return
+        if flags & _FLAG_T:
+            raise ValueError("transport ops unsupported")
+        old_begin = self.pos_begin
+        self.pos_begin = self.pos + 1
+        self.cur_flags = flags
+        self._absorb(bytes([old_begin, flags]))
+        force_f = flags & (_FLAG_C | _FLAG_K) != 0
+        if force_f and self.pos != 0:
+            self._run_f()
+
+    def meta_ad(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_M | _FLAG_A, more)
+        self._absorb(data)
+
+    def ad(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_A, more)
+        self._absorb(data)
+
+    def prf(self, n: int, more: bool) -> bytes:
+        self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C, more)
+        return self._squeeze(n)
+
+    def key(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_A | _FLAG_C, more)
+        self._overwrite(data)
+
+
+# -- merlin transcript ------------------------------------------------------
+
+class Transcript:
+    __slots__ = ("strobe",)
+
+    def __init__(self, label: bytes):
+        self.strobe = Strobe128(b"Merlin v1.0")
+        self.append_message(b"dom-sep", label)
+
+    def clone(self) -> "Transcript":
+        c = object.__new__(Transcript)
+        c.strobe = self.strobe.clone()
+        return c
+
+    def append_message(self, label: bytes, message: bytes) -> None:
+        self.strobe.meta_ad(label, False)
+        self.strobe.meta_ad(struct.pack("<I", len(message)), True)
+        self.strobe.ad(message, False)
+
+    def append_u64(self, label: bytes, v: int) -> None:
+        self.append_message(label, struct.pack("<Q", v))
+
+    def challenge_bytes(self, label: bytes, n: int) -> bytes:
+        self.strobe.meta_ad(label, False)
+        self.strobe.meta_ad(struct.pack("<I", n), True)
+        return self.strobe.prf(n, False)

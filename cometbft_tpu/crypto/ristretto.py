@@ -1,0 +1,107 @@
+"""ristretto255 group (RFC 9496) over edwards25519, host-side.
+
+Implements decode/encode/equality per the RFC's field-op pseudocode,
+reusing the integer curve arithmetic from ed25519_ref. This backs the
+sr25519 signature scheme (the reference gets it from curve25519-voi).
+
+Conformance: the generator's ristretto encoding and the small-multiple
+vectors from RFC 9496 §A are asserted in tests/test_sr25519.py.
+"""
+
+from __future__ import annotations
+
+from . import ed25519_ref as ref
+
+P = ref.P
+D = ref.D
+SQRT_M1 = ref.SQRT_M1
+
+
+def _is_neg(x: int) -> bool:
+    return (x % P) & 1 == 1
+
+
+def _abs(x: int) -> int:
+    x %= P
+    return P - x if _is_neg(x) else x
+
+
+def _sqrt_ratio_m1(u: int, v: int) -> tuple[bool, int]:
+    """(was_square, r) with r = sqrt(u/v) (or sqrt(i*u/v)), CT_ABS'd."""
+    v3 = (v * v % P) * v % P
+    v7 = (v3 * v3 % P) * v % P
+    r = (u * v3 % P) * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    correct = check == u % P
+    flipped = check == (-u) % P
+    flipped_i = check == (-u) % P * SQRT_M1 % P
+    if flipped or flipped_i:
+        r = r * SQRT_M1 % P
+    return (correct or flipped), _abs(r)
+
+
+# 1/sqrt(a - d) with a = -1: invsqrt(-1 - d)
+_ok, INVSQRT_A_MINUS_D = _sqrt_ratio_m1(1, (-1 - D) % P)
+assert _ok
+
+
+def decode(s_bytes: bytes):
+    """32-byte string -> extended edwards point (x, y, z, t) or None.
+
+    Rejects non-canonical and negative field encodings (RFC 9496 §4.3.1).
+    """
+    if len(s_bytes) != 32:
+        return None
+    s = int.from_bytes(s_bytes, "little")
+    if s >= P or s & 1:  # non-canonical or negative
+        return None
+    ss = s * s % P
+    u1 = (1 - ss) % P          # 1 + a*s^2, a = -1
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = (-(D * u1 % P * u1) - u2_sqr) % P   # a*d*u1^2 - u2^2
+    ok, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = _abs(2 * s % P * den_x % P)
+    y = u1 * den_y % P
+    t = x * y % P
+    if not ok or _is_neg(t) or y == 0:
+        return None
+    return (x, y, 1, t)
+
+
+def encode(pt) -> bytes:
+    """Extended edwards point -> canonical 32-byte ristretto encoding."""
+    x0, y0, z0, t0 = (c % P for c in pt)
+    u1 = (z0 + y0) % P * ((z0 - y0) % P) % P
+    u2 = x0 * y0 % P
+    _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+    den1 = invsqrt * u1 % P
+    den2 = invsqrt * u2 % P
+    z_inv = den1 * den2 % P * t0 % P
+    if _is_neg(t0 * z_inv % P):
+        x, y = y0 * SQRT_M1 % P, x0 * SQRT_M1 % P
+        den_inv = den1 * INVSQRT_A_MINUS_D % P
+    else:
+        x, y = x0, y0
+        den_inv = den2
+    if _is_neg(x * z_inv % P):
+        y = (-y) % P
+    s = _abs(den_inv * ((z0 - y) % P) % P)
+    return s.to_bytes(32, "little")
+
+
+def equals(p, q) -> bool:
+    """Ristretto equality: x1*y2 == y1*x2 or y1*y2 == x1*x2."""
+    x1, y1 = p[0] % P, p[1] % P
+    x2, y2 = q[0] % P, q[1] % P
+    return (x1 * y2 - y1 * x2) % P == 0 or (y1 * y2 - x1 * x2) % P == 0
+
+
+# group ops are plain edwards ops on coset representatives
+add = ref._ext_add
+neg = ref._ext_neg
+scalar_mul = ref._ext_scalar_mul
+BASE = ref.B_POINT
+IDENTITY = ref._IDENT

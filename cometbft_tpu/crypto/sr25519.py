@@ -1,0 +1,188 @@
+"""sr25519 (schnorrkel) keys: Schnorr over ristretto255 with merlin
+transcripts.
+
+Behavior parity with reference crypto/sr25519/ (which delegates to
+curve25519-voi's schnorrkel implementation):
+- 32-byte MiniSecretKey, expanded in Ed25519 mode: SHA-512(mini),
+  clamp the low half like ed25519, divide by the cofactor (schnorrkel's
+  scalar convention), nonce = high half (privkey.go:15's signingCtx and
+  UnmarshalJSON's ExpandEd25519).
+- Signing context: merlin Transcript("SigningContext") absorbing the
+  empty context label, then per-message "sign-bytes" (reference
+  privkey.go:47 NewTranscriptBytes).
+- Sign: proto-name "Schnorr-sig", commit pk, witness R = r·B, commit R,
+  challenge scalar c = wide-reduced 64-byte challenge "sign:c",
+  s = c·key + r; signature = R ‖ s with schnorrkel's bit-255 marker.
+- Verify: recompute c from the same transcript, accept iff
+  encode(s·B − c·A) == R_bytes (ristretto encoding equality).
+- Batch verification: per-signature host verification (the per-lane
+  TPU path currently covers ed25519 only; sr25519 commits take the
+  host path, still behind the same BatchVerifier seam —
+  crypto/batch.py dispatch).
+
+Address = SHA256-20 of the 32-byte public key (reference pubkey.go:27).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from . import ristretto as R
+from .keys import BatchVerifier, PrivKey, PubKey, tmhash20
+from .merlin import Transcript
+
+KEY_TYPE = "tendermint/PubKeySr25519"
+PUB_KEY_SIZE = 32
+PRIV_KEY_SIZE = 32
+SIG_SIZE = 64
+
+L = R.ref.L
+
+
+def _signing_context_transcript(msg: bytes) -> Transcript:
+    """signingCtx = NewSigningContext([]byte{}); .NewTranscriptBytes(msg)."""
+    t = Transcript(b"SigningContext")
+    t.append_message(b"", b"")
+    t.append_message(b"sign-bytes", msg)
+    return t
+
+
+def _challenge_scalar(t: Transcript, label: bytes) -> int:
+    return int.from_bytes(t.challenge_bytes(label, 64), "little") % L
+
+
+def _expand_ed25519(mini: bytes) -> tuple[int, bytes]:
+    """(key scalar, nonce) — schnorrkel ExpandEd25519."""
+    h = hashlib.sha512(mini).digest()
+    key = bytearray(h[:32])
+    key[0] &= 248
+    key[31] &= 63
+    key[31] |= 64
+    # divide_scalar_bytes_by_cofactor: clamped value ≡ 0 (mod 8), exact
+    return int.from_bytes(key, "little") >> 3, h[32:]
+
+
+def _verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    if len(sig) != SIG_SIZE or not (sig[63] & 0x80):
+        return False  # missing schnorrkel v1 marker
+    a_pt = R.decode(pub)
+    if a_pt is None:
+        return False
+    r_bytes = sig[:32]
+    s_enc = bytearray(sig[32:])
+    s_enc[31] &= 0x7F
+    s = int.from_bytes(s_enc, "little")
+    if s >= L:
+        return False
+    if R.decode(r_bytes) is None:
+        return False
+    t = _signing_context_transcript(msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    t.append_message(b"sign:R", r_bytes)
+    c = _challenge_scalar(t, b"sign:c")
+    # s·B − c·A must encode to R
+    lhs = R.add(R.scalar_mul(s, R.BASE), R.neg(R.scalar_mul(c, a_pt)))
+    return R.encode(lhs) == r_bytes
+
+
+class Sr25519PubKey(PubKey):
+    __slots__ = ("_b",)
+
+    def __init__(self, b: bytes):
+        if len(b) != PUB_KEY_SIZE:
+            raise ValueError(f"sr25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._b = bytes(b)
+
+    def address(self) -> bytes:
+        return tmhash20(self._b)
+
+    def bytes(self) -> bytes:
+        return self._b
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return _verify_one(self._b, msg, sig)
+
+    def type_tag(self) -> str:
+        return KEY_TYPE
+
+    def __repr__(self):
+        return f"Sr25519PubKey({self._b.hex()[:16]}…)"
+
+
+class Sr25519PrivKey(PrivKey):
+    __slots__ = ("_mini", "_key", "_nonce", "_pub")
+
+    def __init__(self, mini: bytes):
+        if len(mini) != PRIV_KEY_SIZE:
+            raise ValueError("sr25519 privkey must be 32 bytes (MiniSecretKey)")
+        self._mini = bytes(mini)
+        self._key, self._nonce = _expand_ed25519(self._mini)
+        self._pub = R.encode(R.scalar_mul(self._key, R.BASE))
+
+    @classmethod
+    def generate(cls) -> "Sr25519PrivKey":
+        return cls(secrets.token_bytes(32))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Sr25519PrivKey":
+        return cls(hashlib.sha256(secret).digest())
+
+    def sign(self, msg: bytes) -> bytes:
+        t = _signing_context_transcript(msg)
+        t.append_message(b"proto-name", b"Schnorr-sig")
+        t.append_message(b"sign:pk", self._pub)
+        # witness scalar: transcript-bound nonce + fresh randomness
+        wt = t.clone()
+        wt.append_message(b"signing", self._nonce)
+        rnd = secrets.token_bytes(32)
+        r = int.from_bytes(
+            wt.challenge_bytes(b"", 64) + rnd, "little"
+        ) % L
+        r_bytes = R.encode(R.scalar_mul(r, R.BASE))
+        t.append_message(b"sign:R", r_bytes)
+        c = _challenge_scalar(t, b"sign:c")
+        s = (c * self._key + r) % L
+        s_enc = bytearray(s.to_bytes(32, "little"))
+        s_enc[31] |= 0x80  # schnorrkel v1 marker
+        return r_bytes + bytes(s_enc)
+
+    def pub_key(self) -> Sr25519PubKey:
+        return Sr25519PubKey(self._pub)
+
+    def bytes(self) -> bytes:
+        return self._mini
+
+    def type_tag(self) -> str:
+        return KEY_TYPE
+
+
+class Sr25519BatchVerifier(BatchVerifier):
+    """BatchVerifier seam for sr25519 (reference crypto/sr25519/batch.go).
+
+    Verification runs per-signature on the host: sr25519 volume in
+    commits is minority-curve (BASELINE mixed-curve config) and the
+    transcript hashing is inherently sequential per message.
+    """
+
+    def __init__(self, backend: str = "host"):
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+        self.backend = backend
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+        if not isinstance(pub_key, Sr25519PubKey):
+            return False
+        if len(sig) != SIG_SIZE:
+            return False
+        self._items.append((pub_key.bytes(), msg, sig))
+        return True
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if not self._items:
+            return False, []
+        bits = [_verify_one(p, m, s) for p, m, s in self._items]
+        return all(bits), bits

@@ -7,13 +7,12 @@ import threading
 from ..encoding import proto as pb
 from ..storage.kv import KVStore, MemKV
 from ..types import Commit, Header, Validator, ValidatorSet
-from ..types.validator_set import encode_pub_key
-from ..crypto.ed25519 import Ed25519PubKey
+from ..types.validator_set import decode_pub_key, encode_pub_key
 from .types import LightBlock, SignedHeader
 
 
 def _key(h: int) -> bytes:
-    return b"LB:" + h.to_bytes(8, "big")
+    return b"LB2:" + h.to_bytes(8, "big")  # v2: proto-encoded pubkeys
 
 
 def _encode_vals(vals: ValidatorSet) -> bytes:
@@ -21,7 +20,7 @@ def _encode_vals(vals: ValidatorSet) -> bytes:
     for v in vals.validators:
         out += pb.f_embedded(
             1,
-            pb.f_bytes(1, v.pub_key.bytes())
+            pb.f_embedded(1, encode_pub_key(v.pub_key))
             + pb.f_varint(2, v.voting_power)
             + pb.f_varint(3, v.proposer_priority + (1 << 62)),  # offset-encode
         )
@@ -35,7 +34,8 @@ def _decode_vals(buf: bytes) -> ValidatorSet:
             continue
         d = pb.fields_to_dict(bytes(v))
         val = Validator.from_pub_key(
-            Ed25519PubKey(bytes(d.get(1, b""))), pb.to_i64(d.get(2, 0))
+            decode_pub_key(pb.fields_to_dict(bytes(d.get(1, b"")))),
+            pb.to_i64(d.get(2, 0)),
         )
         val.proposer_priority = pb.to_i64(d.get(3, 0)) - (1 << 62)
         vals.append(val)

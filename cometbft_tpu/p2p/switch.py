@@ -56,6 +56,7 @@ class Switch:
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._accept_thread: threading.Thread | None = None
+        self._upgrade_slots = threading.Semaphore(self.MAX_PENDING_UPGRADES)
 
     # ------------------------------------------------------------------
     def add_reactor(self, reactor: Reactor) -> None:
@@ -75,10 +76,14 @@ class Switch:
                                                daemon=True)
         self._accept_thread.start()
 
+    MAX_PENDING_UPGRADES = 32  # reference p2p MaxIncomingConnections-style cap
+
     def _accept_loop(self) -> None:
         # The handshake runs on a per-connection thread: a dialer that
         # connects and goes silent burns its own 10s timeout, not the
-        # accept loop's, so inbound admission never serializes.
+        # accept loop's, so inbound admission never serializes. The
+        # semaphore bounds concurrent in-flight upgrades so a connection
+        # flood cannot exhaust threads/file descriptors.
         while not self._stopped.is_set():
             try:
                 raw = self.transport.accept_raw()
@@ -86,6 +91,12 @@ class Switch:
                 continue
             if raw is None:
                 return
+            if not self._upgrade_slots.acquire(blocking=False):
+                try:
+                    raw.close()  # saturated: shed load
+                except OSError:
+                    pass
+                continue
             threading.Thread(
                 target=self._upgrade_and_add, args=(raw,), daemon=True
             ).start()
@@ -99,6 +110,8 @@ class Switch:
                 raw.close()
             except OSError:
                 pass
+        finally:
+            self._upgrade_slots.release()
 
     def dial_peer(self, host: str, port: int) -> Peer:
         sc, info = self.transport.dial(host, port)

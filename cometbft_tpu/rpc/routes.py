@@ -295,8 +295,13 @@ def broadcast_tx_commit(env, params, timeout_s: float = 30.0):
         f"btc-{tmhash(tx).hex()[:8]}", f"tm.event = 'Tx' AND tx.hash = '{_hx(tmhash(tx))}'"
     )
     try:
+        from ..utils.pubsub import SubscriptionCancelled
+
         env.mempool.check_tx(tx)
-        msg = sub.next(timeout=timeout_s)
+        try:
+            msg = sub.next(timeout=timeout_s)
+        except SubscriptionCancelled:
+            msg = None
         if msg is None:
             raise RPCError(-32603, "timed out waiting for tx commit")
         return {

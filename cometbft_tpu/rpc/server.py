@@ -148,11 +148,32 @@ class RPCServer:
                 stop = threading.Event()
 
                 def pump():
+                    from ..utils.pubsub import SubscriptionCancelled
+
                     while not stop.is_set():
                         with lock:
                             items = list(subs.items())
                         for q, sub in items:
-                            msg = sub.next(timeout=0.05)
+                            try:
+                                msg = sub.next(timeout=0.05)
+                            except SubscriptionCancelled:
+                                with lock:
+                                    subs.pop(q, None)
+                                try:
+                                    with lock:
+                                        _ws_send_text(self.wfile, json.dumps({
+                                            "jsonrpc": "2.0",
+                                            "id": -1,
+                                            "error": {
+                                                "code": -32000,
+                                                "message": "subscription cancelled"
+                                                           " (client too slow)",
+                                                "data": q,
+                                            },
+                                        }))
+                                except OSError:
+                                    stop.set()
+                                continue
                             if msg is None:
                                 continue
                             try:

@@ -16,6 +16,7 @@ from dataclasses import replace
 
 from ..crypto import merkle
 from ..crypto.ed25519 import Ed25519PubKey
+from ..crypto.secp256k1 import Secp256k1PubKey
 from ..types import (
     Block,
     BlockID,
@@ -36,6 +37,17 @@ from .types import State
 
 class BlockValidationError(Exception):
     pass
+
+
+def _pub_key_from_update(vu) -> Ed25519PubKey | Secp256k1PubKey:
+    """ABCI ValidatorUpdate pub_key_type dispatch (reference
+    abci/types PubKeyType strings via crypto/encoding codec)."""
+    t = vu.pub_key_type
+    if t in ("ed25519", "tendermint/PubKeyEd25519"):
+        return Ed25519PubKey(vu.pub_key_bytes)
+    if t in ("secp256k1", "tendermint/PubKeySecp256k1"):
+        return Secp256k1PubKey(vu.pub_key_bytes)
+    raise BlockValidationError(f"unsupported validator key type {t!r}")
 
 
 def median_time(commit: Commit, vals: ValidatorSet) -> Timestamp:
@@ -314,8 +326,11 @@ class BlockExecutor:
         if resp.validator_updates:
             changes = []
             for vu in resp.validator_updates:
-                pk = Ed25519PubKey(vu.pub_key_bytes)
-                changes.append(Validator.from_pub_key(pk, vu.power))
+                changes.append(
+                    Validator.from_pub_key(
+                        _pub_key_from_update(vu), vu.power
+                    )
+                )
             n_vals.update_with_change_set(changes)
             changed = block.header.height + 2
         n_vals.increment_proposer_priority(1)

@@ -9,11 +9,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 
-from ..crypto.ed25519 import Ed25519PubKey
 from ..encoding import proto as pb
 from ..types import BlockID, Timestamp, Validator, ValidatorSet, ZERO_TIME
 from ..types.basic import ZERO_BLOCK_ID
-from ..types.validator_set import encode_pub_key
+from ..types.validator_set import decode_pub_key, encode_pub_key
 
 
 @dataclass(frozen=True)
@@ -70,10 +69,7 @@ def _encode_validator(v: Validator) -> bytes:
 def _decode_validator(buf: bytes) -> Validator:
     d = pb.fields_to_dict(buf)
     key_fields = pb.fields_to_dict(bytes(d.get(2, b"")))
-    if 1 in key_fields:
-        pk = Ed25519PubKey(bytes(key_fields[1]))
-    else:
-        raise ValueError("unsupported pubkey type in storage")
+    pk = decode_pub_key(key_fields)
     return Validator(
         address=bytes(d.get(1, b"")),
         pub_key=pk,

@@ -32,6 +32,10 @@ def _key_block_hash(block_hash: bytes) -> bytes:
     return b"BH:" + block_hash
 
 
+def _key_height_hash(h: int) -> bytes:
+    return b"HH:" + h.to_bytes(8, "big")
+
+
 _KEY_STATE = b"BS:state"
 
 
@@ -74,6 +78,7 @@ class BlockStore:
                 (_key_block(h), block.encode()),
                 (_key_seen_commit(h), seen_commit.encode()),
                 (_key_block_hash(block.hash()), h.to_bytes(8, "big")),
+                (_key_height_hash(h), block.hash()),
             ]
             if block.last_commit is not None and h > 1:
                 sets.append((_key_commit(h - 1), block.last_commit.encode()))
@@ -115,10 +120,12 @@ class BlockStore:
             deletes = []
             pruned = 0
             for h in range(self._base, retain_height):
-                blk = self.load_block(h)
-                if blk is not None:
-                    deletes.append(_key_block_hash(blk.hash()))
-                deletes += [_key_block(h), _key_commit(h), _key_seen_commit(h)]
+                # the HH entry gives the block hash without a decode
+                bh = self._db.get(_key_height_hash(h))
+                if bh:
+                    deletes.append(_key_block_hash(bh))
+                deletes += [_key_block(h), _key_commit(h),
+                            _key_seen_commit(h), _key_height_hash(h)]
                 pruned += 1
             self._base = retain_height
             sets: list = []

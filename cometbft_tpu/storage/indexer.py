@@ -114,6 +114,7 @@ class IndexerService:
                  block_indexer: BlockIndexer):
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
+        self._bus = event_bus
         self._tx_sub = event_bus.subscribe("indexer", "tm.event = 'Tx'")
         self._block_sub = event_bus.subscribe("indexer", "tm.event = 'NewBlock'")
         self._stopped = threading.Event()
@@ -121,14 +122,28 @@ class IndexerService:
         self._thread.start()
 
     def _run(self) -> None:
+        from ..utils.pubsub import SubscriptionCancelled
+
         while not self._stopped.is_set():
-            msg = self._tx_sub.next(timeout=0.1)
+            try:
+                msg = self._tx_sub.next(timeout=0.1)
+            except SubscriptionCancelled:
+                # slow-consumer overflow: events in the gap are lost (the
+                # reference drops slow subscribers too); resubscribe
+                self._tx_sub = self._bus.subscribe("indexer", "tm.event = 'Tx'")
+                msg = None
             if msg is not None:
                 d = msg.data
                 self.tx_indexer.index(
                     d["height"], d["index"], d["tx"], d["result"], msg.events
                 )
-            bmsg = self._block_sub.next(timeout=0.05)
+            try:
+                bmsg = self._block_sub.next(timeout=0.05)
+            except SubscriptionCancelled:
+                self._block_sub = self._bus.subscribe(
+                    "indexer", "tm.event = 'NewBlock'"
+                )
+                bmsg = None
             if bmsg is not None:
                 self.block_indexer.index(
                     bmsg.data["block"].header.height, bmsg.events

@@ -11,6 +11,14 @@ import json
 from dataclasses import dataclass, field
 
 from ..crypto.ed25519 import Ed25519PubKey
+
+
+def _genesis_pub_key(gv):
+    if "Secp256k1" in gv.pub_key_type:
+        from ..crypto.secp256k1 import Secp256k1PubKey
+
+        return Secp256k1PubKey(gv.pub_key_bytes)
+    return Ed25519PubKey(gv.pub_key_bytes)
 from .basic import Timestamp
 from .validator_set import Validator, ValidatorSet
 
@@ -22,6 +30,7 @@ class GenesisValidator:
     pub_key_bytes: bytes
     power: int
     name: str = ""
+    pub_key_type: str = "tendermint/PubKeyEd25519"
 
 
 @dataclass
@@ -44,13 +53,27 @@ class GenesisDoc:
         for gv in self.validators:
             if gv.power < 0:
                 raise ValueError("genesis: negative validator power")
-            if len(gv.pub_key_bytes) != 32:
-                raise ValueError("genesis: bad ed25519 pubkey size")
+            if gv.pub_key_type not in (
+                "tendermint/PubKeyEd25519",
+                "tendermint/PubKeySecp256k1",
+            ):
+                # sr25519 keys sign votes but have no proto PublicKey
+                # representation, so they cannot appear in validator
+                # sets (matches reference crypto/encoding/codec.go)
+                raise ValueError(
+                    f"genesis: validator key type {gv.pub_key_type!r} "
+                    "not supported in validator sets"
+                )
+            want = 33 if "Secp256k1" in gv.pub_key_type else 32
+            if len(gv.pub_key_bytes) != want:
+                raise ValueError(
+                    f"genesis: bad {gv.pub_key_type} pubkey size"
+                )
 
     def validator_set(self) -> ValidatorSet:
         return ValidatorSet(
             [
-                Validator.from_pub_key(Ed25519PubKey(gv.pub_key_bytes), gv.power)
+                Validator.from_pub_key(_genesis_pub_key(gv), gv.power)
                 for gv in self.validators
             ]
         )
@@ -68,6 +91,7 @@ class GenesisDoc:
                 "validators": [
                     {
                         "pub_key": gv.pub_key_bytes.hex(),
+                        "pub_key_type": gv.pub_key_type,
                         "power": gv.power,
                         "name": gv.name,
                     }
@@ -91,7 +115,10 @@ class GenesisDoc:
             initial_height=d.get("initial_height", 1),
             validators=[
                 GenesisValidator(
-                    bytes.fromhex(v["pub_key"]), v["power"], v.get("name", "")
+                    bytes.fromhex(v["pub_key"]),
+                    v["power"],
+                    v.get("name", ""),
+                    v.get("pub_key_type", "tendermint/PubKeyEd25519"),
                 )
                 for v in d.get("validators", [])
             ],

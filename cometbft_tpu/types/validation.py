@@ -15,7 +15,6 @@ Behavior parity with reference types/validation.go:
 
 from __future__ import annotations
 
-from ..crypto import ed25519
 from ..crypto.keys import PubKey
 from .basic import BlockID
 from .block import BlockIDFlag, Commit
@@ -51,30 +50,56 @@ class ErrNotEnoughVotingPower(CommitError):
 def _verify_items(items, backend: str):
     """items: list of (pubkey, msg, sig, power_if_counted). Returns tally.
 
+    Mixed-curve commits are partitioned by key type and each group goes
+    to its own batch verifier (ed25519 → TPU kernel, sr25519 → host
+    batch); key types without batch support (secp256k1) verify singly —
+    matching the reference's batchSigIdxs dispatch
+    (types/validation.go:274-311, crypto/batch/batch.go:11-35).
     Raises ErrInvalidSignature naming the first invalid index.
     """
     if len(items) >= BATCH_VERIFY_THRESHOLD:
-        bv = ed25519.Ed25519BatchVerifier(backend=backend)
-        addable = True
-        for pub, msg, sig, _ in items:
-            if not bv.add(pub, msg, sig):
-                addable = False
-        ok, bits = (False, None)
-        if addable:
+        from ..crypto.batch import create_batch_verifier
+
+        groups: dict[str, tuple[object, list[int]]] = {}
+        singles: list[int] = []
+        for i, (pub, msg, sig, _) in enumerate(items):
+            tag = pub.type_tag()
+            if tag not in groups:
+                groups[tag] = (create_batch_verifier(pub, backend=backend), [])
+            bv, idxs = groups[tag]
+            if bv is None:
+                singles.append(i)
+                continue
+            before = bv.count()
+            added = bv.add(pub, msg, sig)
+            if bv.count() > before:
+                # verifier took the item (possibly pre-marked invalid):
+                # its bitmap stays index-aligned
+                idxs.append(i)
+            elif not added:
+                singles.append(i)  # rejected outright: decide singly
+        for bv, idxs in groups.values():
+            if bv is None or not idxs:
+                continue
             ok, bits = bv.verify()
-        if not ok:
+            if ok:
+                continue
             if bits:
                 # device bitmap pinpoints failures directly — no rescan
-                for i, b in enumerate(bits):
+                for j, b in zip(idxs, bits):
                     if not b:
-                        raise ErrInvalidSignature(f"invalid signature at index {i}")
-            else:
-                # batch could not run (e.g. unsupported key type): fall back
-                # to single verification like the reference (:327). If every
-                # signature passes singly, the commit is valid — accept.
-                for i, (pub, msg, sig, _) in enumerate(items):
-                    if not pub.verify_signature(msg, sig):
-                        raise ErrInvalidSignature(f"invalid signature at index {i}")
+                        raise ErrInvalidSignature(f"invalid signature at index {j}")
+            # batch could not localize: fall back to single verification
+            # like the reference (:327). If every signature passes singly,
+            # the commit is valid — accept.
+            for j in idxs:
+                pub, msg, sig, _ = items[j]
+                if not pub.verify_signature(msg, sig):
+                    raise ErrInvalidSignature(f"invalid signature at index {j}")
+        for i in singles:
+            pub, msg, sig, _ = items[i]
+            if not pub.verify_signature(msg, sig):
+                raise ErrInvalidSignature(f"invalid signature at index {i}")
     else:
         for i, (pub, msg, sig, _) in enumerate(items):
             if not pub.verify_signature(msg, sig):

@@ -34,13 +34,28 @@ def _trunc_div(a: int, b: int) -> int:
 
 
 def encode_pub_key(pk: PubKey) -> bytes:
-    """crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2."""
+    """crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2.
+
+    sr25519 deliberately has no proto representation, matching the
+    reference codec (crypto/encoding/codec.go:44-50; keys.proto:15-16)."""
     tag = pk.type_tag()
     if "Ed25519" in tag:
         return pb.f_bytes(1, pk.bytes(), emit_empty=True)
     if "Secp256k1" in tag:
         return pb.f_bytes(2, pk.bytes(), emit_empty=True)
     raise ValueError(f"unsupported key type {tag}")
+
+
+def decode_pub_key(fields: dict) -> PubKey:
+    """Inverse of encode_pub_key from parsed proto fields {tag: bytes}."""
+    from ..crypto.ed25519 import Ed25519PubKey
+    from ..crypto.secp256k1 import Secp256k1PubKey
+
+    if 1 in fields:
+        return Ed25519PubKey(bytes(fields[1]))
+    if 2 in fields:
+        return Secp256k1PubKey(bytes(fields[2]))
+    raise ValueError("unknown public key oneof")
 
 
 @dataclass

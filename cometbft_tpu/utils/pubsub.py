@@ -101,6 +101,11 @@ class Message:
     events: dict[str, list[str]] = field(default_factory=dict)
 
 
+class SubscriptionCancelled(Exception):
+    """The subscription was dropped (slow-consumer overflow or explicit
+    unsubscribe); the consumer should resubscribe if it still cares."""
+
+
 class Subscription:
     def __init__(self, query: Query, capacity: int = 256):
         self.query = query
@@ -125,12 +130,26 @@ class Subscription:
             self._cv.notify_all()
 
     def next(self, timeout: float | None = None) -> Message | None:
+        """Pop the next message, or None on timeout. Raises
+        SubscriptionCancelled once the subscription was dropped (capacity
+        overflow or unsubscribe) so consumers can resubscribe instead of
+        polling a dead buffer forever."""
         with self._cv:
+            if self.cancelled:
+                raise SubscriptionCancelled(self.query.source)
             if not self._buf:
                 self._cv.wait(timeout)
+            if self.cancelled:
+                raise SubscriptionCancelled(self.query.source)
             if self._buf:
                 return self._buf.pop(0)
             return None
+
+    def cancel(self) -> None:
+        with self._cv:
+            self.cancelled = True
+            self._buf.clear()
+            self._cv.notify_all()
 
     def drain(self) -> list[Message]:
         with self._cv:
@@ -154,18 +173,27 @@ class PubSubServer:
         with self._lock:
             sub = self._subs.pop((client_id, query_str), None)
         if sub:
-            sub.cancelled = True
+            sub.cancel()
 
     def unsubscribe_all(self, client_id: str) -> None:
         with self._lock:
             gone = [k for k in self._subs if k[0] == client_id]
             for k in gone:
-                self._subs.pop(k).cancelled = True
+                self._subs.pop(k).cancel()
 
     def publish(self, data, events: dict[str, list[str]] | None = None) -> None:
         msg = Message(data, events or {})
         with self._lock:
-            subs = list(self._subs.values())
-        for sub in subs:
+            subs = list(self._subs.items())
+        dead = []
+        for key, sub in subs:
+            if sub.cancelled:
+                dead.append(key)
+                continue
             if sub.query.matches(msg.events):
                 sub.publish(msg)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    if self._subs.get(key) is not None and self._subs[key].cancelled:
+                        self._subs.pop(key, None)

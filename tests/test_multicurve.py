@@ -1,0 +1,202 @@
+"""sr25519 / secp256k1 / merlin / ristretto conformance and the
+mixed-curve commit-verification dispatch (BASELINE mixed-curve config)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.crypto import ristretto as R
+from cometbft_tpu.crypto.batch import create_batch_verifier, supports_batch_verifier
+from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey
+from cometbft_tpu.crypto.merlin import Transcript, keccak_f1600
+from cometbft_tpu.crypto.secp256k1 import N, Secp256k1PrivKey, Secp256k1PubKey
+from cometbft_tpu.crypto.sr25519 import Sr25519BatchVerifier, Sr25519PrivKey
+
+rng = np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------- merlin --
+def test_keccak_f1600_zero_state():
+    st = bytearray(200)
+    keccak_f1600(st)
+    assert st[:8].hex() == "e7dde140798f25f1"  # well-known f(0) prefix
+
+
+def test_merlin_conformance_vector():
+    """The merlin crate's published equivalence-test vector."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    c = t.challenge_bytes(b"challenge", 32)
+    assert c.hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+# ------------------------------------------------------------- ristretto --
+def test_ristretto_generator_multiples():
+    """RFC 9496 §A.1 small multiples of the generator."""
+    expected = [
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+        "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+        "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    ]
+    pt = R.IDENTITY
+    for want in expected:
+        assert R.encode(pt).hex() == want
+        pt = R.add(pt, R.BASE)
+
+
+def test_ristretto_decode_rejects_noncanonical():
+    # field-order encoding (non-canonical) and negative (odd) encodings
+    assert R.decode((R.P).to_bytes(32, "little")) is None
+    assert R.decode((1).to_bytes(32, "little")) is None  # odd => negative
+    # round trip on random scalars
+    for _ in range(8):
+        k = int(rng.integers(1, 2**62))
+        p = R.scalar_mul(k, R.BASE)
+        e = R.encode(p)
+        q = R.decode(e)
+        assert q is not None and R.equals(p, q) and R.encode(q) == e
+
+
+# --------------------------------------------------------------- sr25519 --
+def test_sr25519_sign_verify_tamper():
+    pk = Sr25519PrivKey(b"\x11" * 32)
+    msg = b"vote bytes"
+    sig = pk.sign(msg)
+    assert len(sig) == 64 and sig[63] & 0x80
+    assert pk.pub_key().verify_signature(msg, sig)
+    assert not pk.pub_key().verify_signature(msg + b"!", sig)
+    bad = bytearray(sig)
+    bad[1] ^= 1
+    assert not pk.pub_key().verify_signature(msg, bytes(bad))
+    # marker bit stripped -> reject (schnorrkel v1 rule)
+    nomark = sig[:63] + bytes([sig[63] & 0x7F])
+    assert not pk.pub_key().verify_signature(msg, nomark)
+    # randomized witness: distinct signatures, both valid
+    sig2 = pk.sign(msg)
+    assert sig2 != sig and pk.pub_key().verify_signature(msg, sig2)
+
+
+def test_sr25519_batch_bitmap():
+    bv = Sr25519BatchVerifier()
+    for i in range(6):
+        k = Sr25519PrivKey(bytes([i + 1]) * 32)
+        m = b"msg-%d" % i
+        s = k.sign(m)
+        if i == 4:
+            s = s[:9] + bytes([s[9] ^ 0xFF]) + s[10:]
+        assert bv.add(k.pub_key(), m, s)
+    ok, bits = bv.verify()
+    assert not ok and bits == [True, True, True, True, False, True]
+
+
+# ------------------------------------------------------------- secp256k1 --
+def test_secp256k1_rfc6979_vector():
+    """bitcoin-core's canonical RFC 6979 deterministic-nonce vector."""
+    sk = Secp256k1PrivKey((1).to_bytes(32, "big"))
+    sig = sk.sign(b"Satoshi Nakamoto")
+    assert sig.hex() == (
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8"
+        "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5"
+    )
+    assert sk.pub_key().verify_signature(b"Satoshi Nakamoto", sig)
+
+
+def test_secp256k1_rejects_upper_s_and_tamper():
+    sk = Secp256k1PrivKey.from_secret(b"k")
+    msg = b"tx"
+    sig = sk.sign(msg)
+    r = sig[:32]
+    s = int.from_bytes(sig[32:], "big")
+    assert not sk.pub_key().verify_signature(msg, r + (N - s).to_bytes(32, "big"))
+    bad = bytearray(sig)
+    bad[40] ^= 1
+    assert not sk.pub_key().verify_signature(msg, bytes(bad))
+    assert len(sk.pub_key().address()) == 20
+    assert sk.pub_key().bytes()[0] in (2, 3)
+
+
+def test_secp256k1_no_batch_support():
+    pk = Secp256k1PrivKey.from_secret(b"x").pub_key()
+    assert not supports_batch_verifier(pk)
+    assert create_batch_verifier(pk) is None
+
+
+# ----------------------------------------------------- mixed-curve commit --
+def test_mixed_curve_commit_verify():
+    """A commit signed by ed25519 + sr25519 + secp256k1 validators passes
+    VerifyCommit through the per-curve dispatch, and a corrupted
+    signature on each curve is rejected with its index."""
+    from cometbft_tpu.types import (
+        BlockID,
+        BlockIDFlag,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+        Timestamp,
+    )
+    from cometbft_tpu.types.validation import (
+        ErrInvalidSignature,
+        verify_commit,
+    )
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    privs = []
+    for i in range(4):
+        privs.append(Ed25519PrivKey(bytes([i + 1]) * 32))
+    privs.append(Sr25519PrivKey(b"\x21" * 32))
+    privs.append(Secp256k1PrivKey.from_secret(b"val-5"))
+
+    vals = ValidatorSet([Validator.from_pub_key(p.pub_key(), 10) for p in privs])
+    bid = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    chain_id = "mixed-chain"
+    height = 5
+
+    commit = Commit(height=height, round=0, block_id=bid, signatures=[])
+    from cometbft_tpu.types.vote import SignedMsgType, Vote
+
+    by_addr = {p.pub_key().address(): p for p in privs}
+    for val in vals.validators:
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=height,
+            round=0,
+            block_id=bid,
+            timestamp=Timestamp(1700000000, 0),
+            validator_address=val.address,
+            validator_index=vals.get_by_address(val.address)[0],
+        )
+        sig = by_addr[val.address].sign(v.sign_bytes(chain_id))
+        commit.signatures.append(
+            CommitSig(
+                BlockIDFlag.COMMIT, val.address, Timestamp(1700000000, 0), sig
+            )
+        )
+
+    import cometbft_tpu.types.validation as V
+
+    old = V.BATCH_VERIFY_THRESHOLD
+    V.BATCH_VERIFY_THRESHOLD = 2  # force the batch path
+    try:
+        verify_commit(chain_id, vals, bid, height, commit, backend="tpu")
+        # corrupt each curve's signature in turn
+        for idx in (0, 4, 5):
+            sigs = [cs for cs in commit.signatures]
+            broken = bytearray(sigs[idx].signature)
+            broken[7] ^= 1
+            import dataclasses
+
+            sigs[idx] = dataclasses.replace(
+                sigs[idx], signature=bytes(broken)
+            )
+            bad_commit = Commit(
+                height=height, round=0, block_id=bid, signatures=sigs
+            )
+            with pytest.raises(ErrInvalidSignature):
+                verify_commit(chain_id, vals, bid, height, bad_commit,
+                              backend="tpu")
+    finally:
+        V.BATCH_VERIFY_THRESHOLD = old
