@@ -11,6 +11,7 @@ import hashlib
 
 from .types import (
     Application,
+    ApplySnapshotChunkResult,
     CheckTxResult,
     ExecTxResult,
     FinalizeBlockRequest,
@@ -18,8 +19,10 @@ from .types import (
     InfoResponse,
     InitChainRequest,
     InitChainResponse,
+    OfferSnapshotResult,
     ProposalStatus,
     QueryResponse,
+    Snapshot,
     ValidatorUpdate,
 )
 
@@ -27,12 +30,17 @@ VALIDATOR_PREFIX = b"val:"
 
 
 class KVStoreApp(Application):
-    def __init__(self):
+    def __init__(self, snapshot_interval: int = 0, chunk_size: int = 4096):
         self.store: dict[bytes, bytes] = {}
         self.pending: dict[bytes, bytes] = {}
         self.height = 0
         self.app_hash = b"\x00" * 32
         self.val_updates: list[ValidatorUpdate] = []
+        # -- snapshots (reference abci/example/kvstore + e2e app) --
+        self.snapshot_interval = snapshot_interval
+        self.chunk_size = chunk_size
+        self._snapshots: dict[int, tuple[Snapshot, list[bytes]]] = {}
+        self._restore: dict | None = None  # in-progress state-sync restore
 
     # --- helpers ---
     @staticmethod
@@ -45,13 +53,17 @@ class KVStoreApp(Application):
         return k, v
 
     def _compute_hash(self, height: int) -> bytes:
-        h = hashlib.sha256()
-        h.update(height.to_bytes(8, "big"))
         merged = dict(self.store)
         merged.update(self.pending)
-        for k in sorted(merged):
+        return self._hash_for(merged, height)
+
+    @staticmethod
+    def _hash_for(store: dict[bytes, bytes], height: int) -> bytes:
+        h = hashlib.sha256()
+        h.update(height.to_bytes(8, "big"))
+        for k in sorted(store):
             h.update(len(k).to_bytes(4, "big") + k)
-            h.update(len(merged[k]).to_bytes(4, "big") + merged[k])
+            h.update(len(store[k]).to_bytes(4, "big") + store[k])
         return h.digest()
 
     # --- ABCI ---
@@ -111,7 +123,94 @@ class KVStoreApp(Application):
         self.pending = {}
         self.height += 1
         self.app_hash = self._compute_hash(self.height)
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return 0
+
+    # --- snapshot support (state sync source + target) ---
+    def _serialize_state(self) -> bytes:
+        out = [self.height.to_bytes(8, "big")]
+        for k in sorted(self.store):
+            v = self.store[k]
+            out.append(len(k).to_bytes(4, "big") + k)
+            out.append(len(v).to_bytes(4, "big") + v)
+        return b"".join(out)
+
+    def _take_snapshot(self) -> None:
+        payload = self._serialize_state()
+        chunks = [
+            payload[i : i + self.chunk_size]
+            for i in range(0, len(payload), self.chunk_size)
+        ] or [b""]
+        snap = Snapshot(
+            height=self.height,
+            format=1,
+            chunks=len(chunks),
+            hash=hashlib.sha256(payload).digest(),
+        )
+        self._snapshots[self.height] = (snap, chunks)
+        # keep only the two most recent snapshots
+        for h in sorted(self._snapshots)[:-2]:
+            del self._snapshots[h]
+
+    def list_snapshots(self) -> list[Snapshot]:
+        return [snap for snap, _ in self._snapshots.values()]
+
+    def load_snapshot_chunk(self, height: int, format_: int, chunk: int) -> bytes:
+        entry = self._snapshots.get(height)
+        if entry is None or format_ != 1 or not (0 <= chunk < len(entry[1])):
+            return b""
+        return entry[1][chunk]
+
+    def offer_snapshot(self, snapshot: Snapshot, app_hash: bytes) -> int:
+        if snapshot.format != 1:
+            return OfferSnapshotResult.REJECT_FORMAT
+        if snapshot.chunks <= 0 or not snapshot.hash:
+            return OfferSnapshotResult.REJECT
+        self._restore = {
+            "snapshot": snapshot,
+            "trusted_app_hash": app_hash,
+            "chunks": {},
+        }
+        return OfferSnapshotResult.ACCEPT
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes, sender: str) -> int:
+        if self._restore is None:
+            return ApplySnapshotChunkResult.ABORT
+        snap: Snapshot = self._restore["snapshot"]
+        self._restore["chunks"][index] = chunk
+        if len(self._restore["chunks"]) < snap.chunks:
+            return ApplySnapshotChunkResult.ACCEPT
+        payload = b"".join(
+            self._restore["chunks"][i] for i in range(snap.chunks)
+        )
+        if hashlib.sha256(payload).digest() != snap.hash:
+            self._restore["chunks"].clear()
+            return ApplySnapshotChunkResult.RETRY_SNAPSHOT
+        height = int.from_bytes(payload[:8], "big")
+        store: dict[bytes, bytes] = {}
+        pos = 8
+        while pos < len(payload):
+            kl = int.from_bytes(payload[pos : pos + 4], "big")
+            k = payload[pos + 4 : pos + 4 + kl]
+            pos += 4 + kl
+            vl = int.from_bytes(payload[pos : pos + 4], "big")
+            v = payload[pos + 4 : pos + 4 + vl]
+            pos += 4 + vl
+            store[k] = v
+        trusted = self._restore["trusted_app_hash"]
+        self._restore = None
+        # stage first: the restore only lands if it reproduces the
+        # light-client-verified app hash (a lying snapshot must leave
+        # the app untouched)
+        staged_hash = self._hash_for(store, height)
+        if trusted and staged_hash != trusted:
+            return ApplySnapshotChunkResult.REJECT_SNAPSHOT
+        self.store = store
+        self.pending = {}
+        self.height = height
+        self.app_hash = staged_hash
+        return ApplySnapshotChunkResult.ACCEPT
 
     def query(self, path: str, data: bytes, height: int = 0) -> QueryResponse:
         v = self.store.get(data)

@@ -97,6 +97,28 @@ class ProposalStatus:
     REJECT = 2
 
 
+class OfferSnapshotResult:
+    """reference abci OFFER_SNAPSHOT_RESULT_* enum."""
+
+    UNKNOWN = 0
+    ACCEPT = 1
+    ABORT = 2
+    REJECT = 3
+    REJECT_FORMAT = 4
+    REJECT_SENDER = 5
+
+
+class ApplySnapshotChunkResult:
+    """reference abci APPLY_SNAPSHOT_CHUNK_RESULT_* enum."""
+
+    UNKNOWN = 0
+    ACCEPT = 1
+    ABORT = 2
+    RETRY = 3
+    RETRY_SNAPSHOT = 4
+    REJECT_SNAPSHOT = 5
+
+
 @dataclass
 class Misbehavior:
     type: int = 0  # 1 = duplicate vote, 2 = light client attack

@@ -124,6 +124,28 @@ class BlockSyncConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """reference config.StateSyncConfig (config/config.go StateSync
+    section): opt-in snapshot restore on boot, anchored at a trusted
+    header (hash must come from an out-of-band source)."""
+
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_s: int = 7 * 24 * 3600
+    discovery_time_s: float = 2.0
+    chunk_fetchers: int = 4
+    temp_dir: str = ""
+
+    def validate(self) -> None:
+        if self.enable:
+            if self.trust_height <= 0:
+                raise ValueError("statesync.trust_height required when enabled")
+            if not self.trust_hash:
+                raise ValueError("statesync.trust_hash required when enabled")
+
+
+@dataclass
 class StorageConfig:
     discard_abci_responses: bool = False
 
@@ -142,6 +164,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -149,7 +172,7 @@ class Config:
 
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
-                        self.consensus, self.blocksync):
+                        self.consensus, self.blocksync, self.statesync):
             section.validate()
 
     # -- paths ----------------------------------------------------------
@@ -187,6 +210,7 @@ class Config:
             emit("mempool", self.mempool),
             emit("consensus", self.consensus),
             emit("blocksync", self.blocksync),
+            emit("statesync", self.statesync),
             emit("storage", self.storage),
             emit("instrumentation", self.instrumentation),
         ]
@@ -202,6 +226,7 @@ class Config:
             mempool=MempoolConfig(**d.get("mempool", {})),
             consensus=ConsensusConfig(**d.get("consensus", {})),
             blocksync=BlockSyncConfig(**d.get("blocksync", {})),
+            statesync=StateSyncConfig(**d.get("statesync", {})),
             storage=StorageConfig(**d.get("storage", {})),
             instrumentation=InstrumentationConfig(**d.get("instrumentation", {})),
         )

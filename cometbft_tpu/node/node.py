@@ -149,6 +149,19 @@ class Node:
         self.mempool_reactor.set_switch(self.switch)
         self.switch.add_reactor(self.consensus_reactor)
         self.switch.add_reactor(self.mempool_reactor)
+        # state-sync reactor: always serve local snapshots; the syncing
+        # side (pool + Syncer) activates only when config enables it
+        # (reference node/node.go:427 createStatesyncReactor)
+        from ..statesync import SnapshotPool, StateSyncReactor
+
+        self.statesync_pool = (
+            SnapshotPool() if getattr(config, "statesync", None)
+            and config.statesync.enable else None
+        )
+        self.statesync_reactor = StateSyncReactor(
+            self.app_conns.snapshot, self.statesync_pool
+        )
+        self.switch.add_reactor(self.statesync_reactor)
         self.rpc_env = Env(
             block_store=self.block_store,
             state_store=self.state_store,

@@ -1,0 +1,10 @@
+from .chunks import ChunkQueue  # noqa: F401
+from .provider import LightStateProvider  # noqa: F401
+from .reactor import StateSyncReactor  # noqa: F401
+from .snapshots import SnapshotPool  # noqa: F401
+from .syncer import (  # noqa: F401
+    ErrAbort,
+    ErrNoSnapshots,
+    ErrRejectSnapshot,
+    Syncer,
+)

@@ -1,0 +1,140 @@
+"""State-sync p2p reactor: snapshot advertisement + chunk serving.
+
+Behavior parity: reference internal/statesync/reactor.go — two channels
+(Snapshot 0x60 for metadata, Chunk 0x61 for contents); on AddPeer we
+request their snapshots; inbound SnapshotsRequest answers from the local
+app's ListSnapshots (capped at 10 like recentSnapshots), ChunkRequest
+serves LoadSnapshotChunk; responses feed the syncer's pool and an
+in-flight chunk future that the Syncer's fetch_chunk blocks on.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..p2p.conn import ChannelDescriptor
+from ..p2p.switch import Reactor
+from .messages import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_message,
+)
+from .snapshots import Snapshot
+
+RECENT_SNAPSHOTS = 10
+
+
+class StateSyncReactor(Reactor):
+    def __init__(self, snapshot_conn, pool=None):
+        self.conn = snapshot_conn  # ABCI snapshot connection (serving side)
+        self.pool = pool  # SnapshotPool (syncing side; None on servers)
+        self._peers: dict[str, object] = {}
+        self._lock = threading.Lock()
+        # (height, format, index) -> [event, chunk-or-None]
+        self._pending: dict[tuple[int, int, int], list] = {}
+
+    # -- Reactor interface -------------------------------------------------
+    def channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(id=SNAPSHOT_CHANNEL, priority=5),
+            ChannelDescriptor(id=CHUNK_CHANNEL, priority=3),
+        ]
+
+    def add_peer(self, peer) -> None:
+        with self._lock:
+            self._peers[peer.id] = peer
+        if self.pool is not None:
+            peer.send(SNAPSHOT_CHANNEL, SnapshotsRequest().encode())
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._lock:
+            self._peers.pop(peer.id, None)
+        if self.pool is not None:
+            self.pool.remove_peer(peer.id)
+
+    def receive(self, chan_id: int, peer, raw: bytes) -> None:
+        msg = decode_message(raw)
+        if isinstance(msg, SnapshotsRequest):
+            for snap in (self.conn.list_snapshots() or [])[:RECENT_SNAPSHOTS]:
+                peer.send(
+                    SNAPSHOT_CHANNEL,
+                    SnapshotsResponse(
+                        height=snap.height,
+                        format=snap.format,
+                        chunks=snap.chunks,
+                        hash=snap.hash,
+                        metadata=snap.metadata,
+                    ).encode(),
+                )
+        elif isinstance(msg, SnapshotsResponse):
+            if self.pool is not None:
+                self.pool.add(
+                    Snapshot(
+                        height=msg.height,
+                        format=msg.format,
+                        chunks=msg.chunks,
+                        hash=msg.hash,
+                        metadata=msg.metadata,
+                    ),
+                    peer.id,
+                )
+        elif isinstance(msg, ChunkRequest):
+            chunk = self.conn.load_snapshot_chunk(
+                msg.height, msg.format, msg.index
+            )
+            peer.send(
+                CHUNK_CHANNEL,
+                ChunkResponse(
+                    height=msg.height,
+                    format=msg.format,
+                    index=msg.index,
+                    chunk=chunk or b"",
+                    missing=not chunk,
+                ).encode(),
+            )
+        elif isinstance(msg, ChunkResponse):
+            key = (msg.height, msg.format, msg.index)
+            with self._lock:
+                slot = self._pending.get(key)
+            if slot is not None:
+                slot[1] = None if msg.missing else msg.chunk
+                slot[0].set()
+
+    # -- Syncer seam -------------------------------------------------------
+    def fetch_chunk(self, snapshot, index: int, timeout: float = 10.0):
+        """Request a chunk from a peer advertising this snapshot; blocks
+        for the response (the Syncer runs several of these concurrently)."""
+        peers = []
+        if self.pool is not None:
+            advertisers = set(self.pool.peers(snapshot))
+            with self._lock:
+                peers = [p for pid, p in self._peers.items() if pid in advertisers]
+        if not peers:
+            with self._lock:
+                peers = list(self._peers.values())
+        if not peers:
+            return None
+        peer = peers[index % len(peers)]
+        key = (snapshot.height, snapshot.format, index)
+        slot = [threading.Event(), None]
+        with self._lock:
+            self._pending[key] = slot
+        try:
+            peer.send(
+                CHUNK_CHANNEL,
+                ChunkRequest(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    index=index,
+                ).encode(),
+            )
+            if not slot[0].wait(timeout):
+                return None
+            return slot[1]
+        finally:
+            with self._lock:
+                self._pending.pop(key, None)
