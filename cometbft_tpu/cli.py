@@ -204,6 +204,27 @@ def cmd_inspect_lite(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """reference `cometbft rollback`: overwrite state height n with n-1
+    so block n re-applies (app state untouched)."""
+    from .config import Config
+    from .state.rollback import RollbackError, rollback
+    from .storage import BlockStore, StateStore, open_kv
+
+    p = _cfg_paths(args.home)
+    cfg = Config.load(p["config_file"])
+    mem = cfg.base.db_backend == "mem"
+    bs = BlockStore(open_kv(None if mem else os.path.join(args.home, "data/blockstore.db")))
+    ss = StateStore(open_kv(None if mem else os.path.join(args.home, "data/state.db")))
+    try:
+        height, app_hash = rollback(bs, ss, remove_block=args.hard)
+    except RollbackError as e:
+        print(f"rollback failed: {e}")
+        return 1
+    print(f"rolled back state to height {height} (app hash {app_hash.hex()})")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -228,6 +249,10 @@ def main(argv=None) -> int:
     sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("reset-all").set_defaults(fn=cmd_reset_all)
     sub.add_parser("inspect-lite").set_defaults(fn=cmd_inspect_lite)
+    sp = sub.add_parser("rollback")
+    sp.add_argument("--hard", action="store_true",
+                    help="also remove the pending block from the block store")
+    sp.set_defaults(fn=cmd_rollback)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = ap.parse_args(argv)

@@ -118,6 +118,10 @@ class Node:
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
         )
+        from ..state.pruner import Pruner
+
+        self.pruner = Pruner(self.block_store, self.state_store)
+        self.executor.pruner = self.pruner
 
         # --- consensus -------------------------------------------------
         self.wal = WAL(_p(config.consensus.wal_file))
@@ -197,10 +201,12 @@ class Node:
                 self.switch.dial_peer(hostp, portp)
             except Exception:  # noqa: BLE001 — reference retries async
                 pass
+        self.pruner.start()
         self.consensus.start()
 
     def stop(self) -> None:
         self.consensus.stop()
+        self.pruner.stop()
         self.consensus_reactor.stop()
         self.switch.stop()
         self.indexer_service.stop()

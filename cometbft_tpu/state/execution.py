@@ -182,6 +182,7 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
         self.event_handlers: list = []
+        self.pruner = None  # optional state.pruner.Pruner
 
     # --- proposal side ---
     def create_proposal_block(
@@ -284,14 +285,18 @@ class BlockExecutor:
         if self.mempool is not None:
             self.mempool.lock()
             try:
-                self.app.consensus.commit()
+                retain_height = self.app.consensus.commit()
                 self.mempool.update(
                     block.header.height, block.data.txs, resp.tx_results
                 )
             finally:
                 self.mempool.unlock()
         else:
-            self.app.consensus.commit()
+            retain_height = self.app.consensus.commit()
+        if self.pruner is not None and retain_height:
+            # the app's retain height feeds the background pruner
+            # (reference execution.go Commit -> pruneBlocks)
+            self.pruner.set_app_retain_height(retain_height)
         if self.evidence_pool is not None:
             self.evidence_pool.update(new_state, block.evidence)
 

@@ -109,6 +109,25 @@ class BlockStore:
         raw = self._db.get(_key_seen_commit(height))
         return Commit.decode(raw) if raw else None
 
+    def delete_latest_block(self) -> None:
+        """Remove the top block (rollback support; reference
+        internal/store/store.go DeleteLatestBlock)."""
+        with self._lock:
+            if self._height == 0:
+                raise ValueError("block store is empty")
+            h = self._height
+            deletes = [_key_block(h), _key_seen_commit(h),
+                       _key_commit(h - 1), _key_height_hash(h)]
+            bh = self._db.get(_key_height_hash(h))
+            if bh:
+                deletes.append(_key_block_hash(bh))
+            self._height = h - 1
+            if self._height < self._base:
+                self._base = self._height
+            sets: list = []
+            self._save_meta(sets)
+            self._db.write_batch(sets, deletes)
+
     def prune(self, retain_height: int) -> int:
         """Delete blocks below retain_height; returns number pruned
         (reference internal/store/store.go:309)."""

