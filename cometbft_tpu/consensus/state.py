@@ -27,9 +27,13 @@ import enum
 import queue
 import threading
 import time
+import time as _time
 from dataclasses import dataclass, field as dc_field
 
 from ..state.execution import BlockExecutor, BlockValidationError, validate_block
+from ..utils.fail import fail_point
+from ..utils.log import logger
+from ..utils.metrics import consensus_metrics
 from ..types import (
     Block,
     BlockID,
@@ -121,6 +125,8 @@ class ConsensusState:
         self.name = name or (privval.address().hex()[:8] if privval else "observer")
         self.now_ns = now_ns or time.time_ns
 
+        self._log = logger("consensus").with_fields(node=self.name)
+        self._last_commit_mono: float | None = None
         self.inbox: queue.Queue = queue.Queue()
         self.ticker = (ticker_factory or TimeoutTicker)(self._on_ticker_timeout)
         self.evidence: list[ErrVoteConflictingVotes] = []
@@ -240,6 +246,7 @@ class ConsensusState:
             wal_msg = MsgInfo(_wal_payload(inner), item.peer_id)
             if item.peer_id == "":
                 self.wal.write_sync(wal_msg)  # own msgs hit disk first
+                fail_point()  # reference state.go:843 (own msg persisted)
                 self._handle_msg(inner, item.peer_id)
             else:
                 self.wal.write(wal_msg)
@@ -442,6 +449,8 @@ class ConsensusState:
             return
         if r > self.round:
             self.validators.increment_proposer_priority(r - self.round)
+        self._log.debug("entering new round", height=h, round=r)
+        consensus_metrics().rounds.set(r)
         self._update_step(r, RoundStep.NEW_ROUND)
         self.triggered_timeout_precommit = False
         if r != 0:
@@ -640,6 +649,23 @@ class ConsensusState:
             self.sm_state, maj, block,
         )
         self.decided[h] = maj
+        self._log.info(
+            "finalized block", height=h, round=self.commit_round,
+            txs=len(block.data.txs), hash=block.hash().hex()[:16],
+        )
+        m = consensus_metrics()
+        m.height.set(h)
+        m.validators.set(len(self.validators))
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.inc(len(block.data.txs))
+        m.block_size_bytes.set(len(block.encode()))
+        m.missing_validators.set(
+            sum(1 for cs in seen_commit.signatures if cs.is_absent())
+        )
+        now = _time.monotonic()
+        if self._last_commit_mono is not None:
+            m.block_interval_seconds.observe(now - self._last_commit_mono)
+        self._last_commit_mono = now
         self._update_to_state(new_state, precommits)
 
     def _update_to_state(self, new_state, last_precommits: VoteSet) -> None:

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
+
+from ..utils.metrics import mempool_metrics
 from collections import OrderedDict
 from dataclasses import dataclass
 
@@ -126,8 +128,10 @@ class CListMempool:
             if resp.code != 0:
                 if not self.keep_invalid:
                     self.cache.remove(key)
+                mempool_metrics().failed_txs.inc()
                 raise ValueError(f"tx rejected by app: code {resp.code}")
             self._txs[key] = _MempoolTx(tx, self.height, resp.gas_wanted)
+            mempool_metrics().size.set(len(self._txs))
         for cb in self.on_new_tx:
             cb(tx)
 

@@ -180,6 +180,15 @@ class Node:
             node_info=info,
         )
         self.rpc_server = None
+        self.metrics_server = None
+        if config.instrumentation.prometheus:
+            from ..utils.metrics import MetricsServer
+
+            addr = config.instrumentation.prometheus_listen_addr
+            mhost, _, mport = addr.rpartition(":")
+            self.metrics_server = MetricsServer(
+                host=mhost or "127.0.0.1", port=int(mport or 0)
+            )
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -202,6 +211,8 @@ class Node:
             except Exception:  # noqa: BLE001 — reference retries async
                 pass
         self.pruner.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
         self.consensus.start()
 
     def stop(self) -> None:
@@ -210,5 +221,7 @@ class Node:
         self.consensus_reactor.stop()
         self.switch.stop()
         self.indexer_service.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()

@@ -11,8 +11,12 @@ from __future__ import annotations
 import threading
 from abc import ABC, abstractmethod
 
+from ..utils.log import logger
+from ..utils.metrics import p2p_metrics
 from .conn import ChannelDescriptor, MConnection
 from .transport import NodeInfo, Transport
+
+_log = logger("p2p")
 
 
 class Reactor(ABC):
@@ -137,6 +141,8 @@ class Switch:
                 raise ValueError(f"duplicate or self peer {peer.id}")
             self._peers[peer.id] = peer
         mconn.start()
+        _log.info("peer connected", peer=peer.id[:12], outbound=outbound)
+        p2p_metrics().peers.set(len(self._peers))
         for r in self._reactors:
             r.add_peer(peer)
         return peer
@@ -156,6 +162,8 @@ class Switch:
                 return
             del self._peers[peer.id]
         peer.stop()
+        _log.info("peer stopped", peer=peer.id[:12], reason=str(reason)[:80])
+        p2p_metrics().peers.set(len(self._peers))
         for r in self._reactors:
             r.remove_peer(peer, reason)
 

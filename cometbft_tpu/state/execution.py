@@ -245,14 +245,20 @@ class BlockExecutor:
         block: Block,
         last_commit_preverified: bool = False,
     ) -> State:
-        from ..abci.types import FinalizeBlockRequest
+        import time as _time
 
+        from ..abci.types import FinalizeBlockRequest
+        from ..utils.fail import fail_point
+        from ..utils.metrics import state_metrics
+
+        t0 = _time.perf_counter()
         validate_block(
             state,
             block,
             backend=self.backend,
             last_commit_preverified=last_commit_preverified,
         )
+        state_metrics().block_verify_time.observe(_time.perf_counter() - t0)
         if self.evidence_pool is not None and block.evidence:
             # reject fabricated misbehavior before it reaches the app
             # (reference internal/state/validation.go evpool.CheckEvidence)
@@ -260,6 +266,7 @@ class BlockExecutor:
                 block.evidence, state.consensus_params.evidence.max_bytes
             )
 
+        fail_point()  # reference execution.go:251 (pre-FinalizeBlock)
         resp = self.app.consensus.finalize_block(
             FinalizeBlockRequest(
                 txs=block.data.txs,
@@ -278,10 +285,12 @@ class BlockExecutor:
         if len(resp.tx_results) != len(block.data.txs):
             raise BlockValidationError("app returned wrong number of tx results")
 
+        fail_point()  # reference execution.go:258 (post-FinalizeBlock, pre-save)
         new_state = self._update_state(state, block_id, block, resp)
 
         # Commit with the mempool locked, then update it against the new
         # state (reference execution.go:379 Commit).
+        fail_point()  # reference execution.go:293 (pre-Commit)
         if self.mempool is not None:
             self.mempool.lock()
             try:
@@ -300,6 +309,7 @@ class BlockExecutor:
         if self.evidence_pool is not None:
             self.evidence_pool.update(new_state, block.evidence)
 
+        fail_point()  # reference execution.go:301 (post-Commit, pre-save)
         if self.state_store is not None:
             self.state_store.save(new_state)
             self.state_store.save_finalize_response(
@@ -318,6 +328,9 @@ class BlockExecutor:
                 )
         for handler in self.event_handlers:
             handler(block, resp)
+        state_metrics().block_processing_time.observe(
+            _time.perf_counter() - t0
+        )
         return new_state
 
     def apply_block_preverified(self, state: State, block_id: BlockID, block: Block) -> State:

@@ -1,0 +1,276 @@
+"""Prometheus-compatible metrics (reference per-subsystem metrics.go +
+scripts/metricsgen).
+
+A minimal registry with Counter / Gauge / Histogram supporting labels
+and the text exposition format, served by `MetricsServer` at the
+instrumentation listen address (reference node/node.go:537). Subsystem
+metric bundles mirror the reference's generated structs.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+NAMESPACE = "cometbft"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def _key(self, label_values: tuple) -> tuple:
+        if len(label_values) != len(self.labels):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labels}, got {label_values}"
+            )
+        return label_values
+
+    def _fmt_labels(self, key: tuple) -> str:
+        if not self.labels:
+            return ""
+        pairs = ",".join(
+            f'{k}="{v}"' for k, v in zip(self.labels, key)
+        )
+        return "{" + pairs + "}"
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, *labels) -> None:
+        key = self._key(tuple(labels))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labels:
+            return [f"{self.name} 0"]
+        return [f"{self.name}{self._fmt_labels(k)} {v}" for k, v in items]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, *labels) -> None:
+        with self._lock:
+            self._values[self._key(tuple(labels))] = value
+
+    def add(self, amount: float, *labels) -> None:
+        key = self._key(tuple(labels))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labels:
+            return [f"{self.name} 0"]
+        return [f"{self.name}{self._fmt_labels(k)} {v}" for k, v in items]
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0)
+
+    def __init__(self, name, help_, labels, buckets=None):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, *labels) -> None:
+        key = self._key(tuple(labels))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def expose(self) -> list[str]:
+        out = []
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                cum = 0
+                base = self._fmt_labels(key)[1:-1] if self.labels else ""
+                for i, b in enumerate(self.buckets):
+                    cum = counts[i]
+                    le = f'le="{b}"'
+                    lbl = "{" + (base + "," if base else "") + le + "}"
+                    out.append(f"{self.name}_bucket{lbl} {cum}")
+                lbl = "{" + (base + "," if base else "") + 'le="+Inf"' + "}"
+                out.append(f"{self.name}_bucket{lbl} {counts[-1]}")
+                sfx = "{" + base + "}" if base else ""
+                out.append(f"{self.name}_sum{sfx} {self._sums[key]}")
+                out.append(f"{self.name}_count{sfx} {counts[-1]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str = "",
+                labels: tuple = ()) -> Counter:
+        return self._add(Counter(f"{NAMESPACE}_{subsystem}_{name}", help_,
+                                 tuple(labels)))
+
+    def gauge(self, subsystem: str, name: str, help_: str = "",
+              labels: tuple = ()) -> Gauge:
+        return self._add(Gauge(f"{NAMESPACE}_{subsystem}_{name}", help_,
+                               tuple(labels)))
+
+    def histogram(self, subsystem: str, name: str, help_: str = "",
+                  labels: tuple = (), buckets=None) -> Histogram:
+        return self._add(
+            Histogram(f"{NAMESPACE}_{subsystem}_{name}", help_,
+                      tuple(labels), buckets)
+        )
+
+    def _add(self, m: _Metric):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose_text(self) -> str:
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+# -- subsystem bundles (reference */metrics.go) -----------------------------
+class ConsensusMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.height = reg.gauge("consensus", "height", "Current height")
+        self.rounds = reg.gauge("consensus", "rounds", "Round of the height")
+        self.validators = reg.gauge("consensus", "validators",
+                                    "Validator count")
+        self.missing_validators = reg.gauge(
+            "consensus", "missing_validators",
+            "Validators absent from the last commit")
+        self.block_interval_seconds = reg.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between consecutive blocks")
+        self.num_txs = reg.gauge("consensus", "num_txs", "Txs in last block")
+        self.block_size_bytes = reg.gauge("consensus", "block_size_bytes",
+                                          "Last block size")
+        self.total_txs = reg.counter("consensus", "total_txs",
+                                     "Total committed txs")
+
+
+class MempoolMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.size = reg.gauge("mempool", "size", "Pending txs")
+        self.failed_txs = reg.counter("mempool", "failed_txs",
+                                      "CheckTx rejections")
+        self.recheck_times = reg.counter("mempool", "recheck_times",
+                                         "Post-block rechecks")
+
+
+class P2PMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.peers = reg.gauge("p2p", "peers", "Connected peers")
+        self.message_receive_bytes_total = reg.counter(
+            "p2p", "message_receive_bytes_total", "Bytes received",
+            labels=("chan",))
+        self.message_send_bytes_total = reg.counter(
+            "p2p", "message_send_bytes_total", "Bytes sent",
+            labels=("chan",))
+
+
+class StateMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.block_processing_time = reg.histogram(
+            "state", "block_processing_time",
+            "ApplyBlock wall time (reference execution.go:230)")
+        self.block_verify_time = reg.histogram(
+            "state", "block_verify_time",
+            "Commit signature verification wall time (TPU kernel path)")
+
+
+_BUNDLES: dict[str, object] = {}
+
+
+def consensus_metrics() -> ConsensusMetrics:
+    b = _BUNDLES.get("consensus")
+    if b is None:
+        b = _BUNDLES["consensus"] = ConsensusMetrics()
+    return b
+
+
+def mempool_metrics() -> MempoolMetrics:
+    b = _BUNDLES.get("mempool")
+    if b is None:
+        b = _BUNDLES["mempool"] = MempoolMetrics()
+    return b
+
+
+def p2p_metrics() -> P2PMetrics:
+    b = _BUNDLES.get("p2p")
+    if b is None:
+        b = _BUNDLES["p2p"] = P2PMetrics()
+    return b
+
+
+def state_metrics() -> StateMetrics:
+    b = _BUNDLES.get("state")
+    if b is None:
+        b = _BUNDLES["state"] = StateMetrics()
+    return b
+
+
+class MetricsServer:
+    """Serves the registry at /metrics (reference prometheus listener)."""
+
+    def __init__(self, registry: Registry | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        reg = registry or DEFAULT_REGISTRY
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = reg.expose_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._httpd.server_address
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,135 @@
+"""Metrics, structured logging, and fail-point crash injection
+(reference metrics.go bundles, libs/log, internal/fail)."""
+
+import os
+import subprocess
+import sys
+import urllib.request
+
+from cometbft_tpu.utils import log as cmtlog
+from cometbft_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+def test_metrics_exposition_format():
+    reg = Registry()
+    c = reg.counter("consensus", "total_txs", "Total txs")
+    g = reg.gauge("p2p", "peers", "Peers", labels=("dir",))
+    h = reg.histogram("state", "block_processing_time", "ApplyBlock",
+                      buckets=(0.1, 1.0))
+    c.inc(); c.inc(2)
+    g.set(4, "inbound"); g.set(2, "outbound")
+    h.observe(0.05); h.observe(0.5); h.observe(5)
+    text = reg.expose_text()
+    assert "# TYPE cometbft_consensus_total_txs counter" in text
+    assert "cometbft_consensus_total_txs 3.0" in text
+    assert 'cometbft_p2p_peers{dir="inbound"} 4' in text
+    assert 'cometbft_state_block_processing_time_bucket{le="0.1"} 1' in text
+    assert 'cometbft_state_block_processing_time_bucket{le="+Inf"} 3' in text
+    assert "cometbft_state_block_processing_time_count 3" in text
+
+
+def test_metrics_server_serves_text():
+    reg = Registry()
+    reg.counter("test", "hits", "").inc(7)
+    srv = MetricsServer(registry=reg)
+    srv.start()
+    try:
+        host, port = srv.addr
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "cometbft_test_hits 7.0" in body
+    finally:
+        srv.stop()
+
+
+def test_logger_levels_and_fields():
+    records = []
+    cmtlog.set_sink(lambda level, msg, fields: records.append((level, msg, fields)))
+    try:
+        cmtlog.set_level("consensus:debug,p2p:none,*:info")
+        c = cmtlog.logger("consensus").with_fields(height=5)
+        p = cmtlog.logger("p2p")
+        o = cmtlog.logger("other")
+        c.debug("step", round=1)
+        p.error("dropped")  # p2p: none -> suppressed
+        o.debug("noise")    # default info -> suppressed
+        o.info("kept")
+        assert len(records) == 2
+        lvl, msg, fields = records[0]
+        assert msg == "step" and fields["height"] == 5 and fields["round"] == 1
+        assert records[1][1] == "kept"
+    finally:
+        cmtlog.set_sink(cmtlog._Config._stderr_sink)
+        cmtlog.set_level("info")
+
+
+_CRASH_SCRIPT = r"""
+import os, sys, tempfile
+sys.path.insert(0, os.getcwd())
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cometbft_tpu.consensus.net import FAST_TIMEOUTS, InProcessNetwork
+
+d = sys.argv[1]
+net = InProcessNetwork(1, d, timeouts=FAST_TIMEOUTS)
+net.start()
+net.wait_for_height(3, timeout=60)
+print("reached-3", flush=True)
+# arm the fail point only now (the target env var is read per call):
+# the 2nd fail_point() after this line kills the process mid-height
+os.environ["FAIL_TEST_INDEX"] = "2"
+net.wait_for_height(6, timeout=60)
+print("reached-6", flush=True)
+net.stop()
+"""
+
+_RECOVER_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, os.getcwd())
+import jax
+jax.config.update("jax_platforms", "cpu")
+os.environ.pop("FAIL_TEST_INDEX", None)
+from cometbft_tpu.consensus.net import FAST_TIMEOUTS, InProcessNetwork
+
+d = sys.argv[1]
+net = InProcessNetwork(1, d, timeouts=FAST_TIMEOUTS)
+net.start()
+net.wait_for_height(6, timeout=60)
+print("recovered-to-6", flush=True)
+net.stop()
+"""
+
+
+def test_fail_point_crash_and_wal_recovery(tmp_path):
+    """Kill the node at an injected ApplyBlock crash point, then restart
+    WITHOUT the fail point: WAL + handshake replay must recover and keep
+    committing (reference internal/consensus/replay_test.go crash table)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FAIL_TEST_INDEX", None)  # armed inside the script after h=3
+    d = str(tmp_path)
+    p1 = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, d],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert "reached-3" in p1.stdout, p1.stderr[-2000:]
+    assert p1.returncode == 1, (
+        f"process should die at the fail point, rc={p1.returncode}\n"
+        f"{p1.stderr[-2000:]}"
+    )
+
+    p2 = subprocess.run(
+        [sys.executable, "-c", _RECOVER_SCRIPT, d],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert "recovered-to-6" in p2.stdout
