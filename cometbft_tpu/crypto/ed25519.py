@@ -47,6 +47,13 @@ class Ed25519PubKey(PubKey):
         return self._b
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # hot path for individually-gossiped votes: the native C++ engine
+        # (csrc/ed25519_native.cpp, ~12x the pure-Python oracle); falls
+        # back to the oracle when no toolchain is available
+        from . import native
+
+        if native.available():
+            return native.verify(self._b, msg, sig)
         return ref.verify(self._b, msg, sig)
 
     def type_tag(self) -> str:
@@ -74,6 +81,10 @@ class Ed25519PrivKey(PrivKey):
         return cls(ref.generate_seed())
 
     def sign(self, msg: bytes) -> bytes:
+        from . import native
+
+        if native.available():
+            return native.sign(self._seed, self._pub, msg)
         return ref.sign(self._seed, msg)
 
     def pub_key(self) -> Ed25519PubKey:

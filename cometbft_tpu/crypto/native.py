@@ -1,0 +1,102 @@
+"""ctypes binding for the C++ Ed25519 engine (csrc/ed25519_native.cpp).
+
+Build-on-demand: the shared object compiles once per machine into the
+package directory (g++ is in the base image; pybind11 is not, hence the
+plain C ABI + ctypes). Every entry point degrades gracefully — callers
+fall back to the pure-Python oracle when the toolchain or binary is
+unavailable, so the framework never hard-depends on a compiler.
+
+This is the host-side native path the reference gets from
+curve25519-voi's assembly (reference crypto/ed25519/ed25519.go:13):
+individual vote verification in consensus gossip, privval signing, p2p
+handshake identity. Batch verification stays on the TPU kernels.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc",
+                    "ed25519_native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", _SO, src]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib():
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            ):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.ed25519_verify.restype = ctypes.c_int
+        lib.ed25519_verify.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.ed25519_sign.restype = None
+        lib.ed25519_sign.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.ed25519_pubkey.restype = None
+        lib.ed25519_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """ZIP-215 verify; raises RuntimeError if the native lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ed25519 unavailable")
+    return bool(lib.ed25519_verify(pub, msg, len(msg), sig))
+
+
+def sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ed25519 unavailable")
+    out = ctypes.create_string_buffer(64)
+    lib.ed25519_sign(seed, pub, msg, len(msg), out)
+    return out.raw
+
+
+def pubkey(seed: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ed25519 unavailable")
+    out = ctypes.create_string_buffer(32)
+    lib.ed25519_pubkey(seed, out)
+    return out.raw

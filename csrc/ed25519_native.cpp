@@ -1,0 +1,678 @@
+// Native Ed25519 sign/verify for the host-side control plane.
+//
+// The data plane (batch verification) runs on TPU (cometbft_tpu/ops);
+// this covers the per-signature host path — individual gossiped votes,
+// privval signing, p2p handshake identity — where the reference leans
+// on curve25519-voi's assembly (reference crypto/ed25519/ed25519.go:13).
+//
+// Original implementation derived from RFC 8032 + the curve equations:
+// - field GF(2^255-19): 5 x 51-bit limbs, products via unsigned __int128
+// - points: extended homogeneous (X, Y, Z, T), complete a=-1 addition
+// - scalars mod L: 4 x 64-bit words, Barrett-free binary reduction
+// - verification uses ZIP-215 semantics: liberal decoding, cofactored
+//   equation [8]([S]B - [k]A - R) == identity, S < L required
+//
+// Exposed as a tiny C ABI consumed via ctypes (no pybind11 in image).
+
+#include <cstdint>
+#include <cstring>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+typedef uint8_t u8;
+
+// ----------------------------------------------------------- SHA-512 ----
+namespace sha512 {
+
+static const u64 K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+static inline u64 rotr(u64 x, int n) { return (x >> n) | (x << (64 - n)); }
+
+struct Ctx {
+    u64 h[8];
+    u8 buf[128];
+    u64 total;
+    size_t fill;
+};
+
+static void init(Ctx *c) {
+    static const u64 iv[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+        0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+    memcpy(c->h, iv, sizeof iv);
+    c->total = 0;
+    c->fill = 0;
+}
+
+static void block(Ctx *c, const u8 *p) {
+    u64 w[80];
+    for (int i = 0; i < 16; i++) {
+        w[i] = ((u64)p[8 * i] << 56) | ((u64)p[8 * i + 1] << 48) |
+               ((u64)p[8 * i + 2] << 40) | ((u64)p[8 * i + 3] << 32) |
+               ((u64)p[8 * i + 4] << 24) | ((u64)p[8 * i + 5] << 16) |
+               ((u64)p[8 * i + 6] << 8) | (u64)p[8 * i + 7];
+    }
+    for (int i = 16; i < 80; i++) {
+        u64 s0 = rotr(w[i - 15], 1) ^ rotr(w[i - 15], 8) ^ (w[i - 15] >> 7);
+        u64 s1 = rotr(w[i - 2], 19) ^ rotr(w[i - 2], 61) ^ (w[i - 2] >> 6);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    u64 a = c->h[0], b = c->h[1], d = c->h[3], e = c->h[4];
+    u64 cc = c->h[2], f = c->h[5], g = c->h[6], h = c->h[7];
+    for (int i = 0; i < 80; i++) {
+        u64 S1 = rotr(e, 14) ^ rotr(e, 18) ^ rotr(e, 41);
+        u64 ch = (e & f) ^ (~e & g);
+        u64 t1 = h + S1 + ch + K[i] + w[i];
+        u64 S0 = rotr(a, 28) ^ rotr(a, 34) ^ rotr(a, 39);
+        u64 maj = (a & b) ^ (a & cc) ^ (b & cc);
+        u64 t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = cc; cc = b; b = a; a = t1 + t2;
+    }
+    c->h[0] += a; c->h[1] += b; c->h[2] += cc; c->h[3] += d;
+    c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+static void update(Ctx *c, const u8 *data, size_t len) {
+    c->total += len;
+    while (len) {
+        size_t take = 128 - c->fill;
+        if (take > len) take = len;
+        memcpy(c->buf + c->fill, data, take);
+        c->fill += take;
+        data += take;
+        len -= take;
+        if (c->fill == 128) {
+            block(c, c->buf);
+            c->fill = 0;
+        }
+    }
+}
+
+static void final(Ctx *c, u8 out[64]) {
+    u64 bits = c->total * 8;
+    u8 pad = 0x80;
+    update(c, &pad, 1);
+    u8 z = 0;
+    while (c->fill != 112) update(c, &z, 1);
+    u8 lenb[16] = {0};
+    for (int i = 0; i < 8; i++) lenb[15 - i] = (u8)(bits >> (8 * i));
+    c->total -= 0;  // length bytes excluded from message length already counted
+    // careful: update() counts these 16 bytes into total, harmless (total unused after)
+    update(c, lenb, 16);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++) out[8 * i + j] = (u8)(c->h[i] >> (56 - 8 * j));
+}
+
+static void hash(const u8 *a, size_t an, const u8 *b, size_t bn,
+                 const u8 *d, size_t dn, u8 out[64]) {
+    Ctx c;
+    init(&c);
+    if (an) update(&c, a, an);
+    if (bn) update(&c, b, bn);
+    if (dn) update(&c, d, dn);
+    final(&c, out);
+}
+
+}  // namespace sha512
+
+// ----------------------------------------------- field GF(2^255-19) ----
+namespace fe {
+
+typedef struct { u64 v[5]; } F;  // 51-bit limbs
+
+static const u64 MASK = (1ULL << 51) - 1;
+
+static void set0(F *o) { memset(o->v, 0, sizeof o->v); }
+static void set1(F *o) { set0(o); o->v[0] = 1; }
+
+static void add(F *o, const F *a, const F *b) {
+    for (int i = 0; i < 5; i++) o->v[i] = a->v[i] + b->v[i];
+}
+
+// o = a - b, with a 4p limbwise bias: b's limbs may be uncarried mul
+// outputs (< 2^52), and 4 * (2^51 - 19) > 2^52 keeps every limb
+// nonnegative while the value shift (4p) vanishes mod p
+static void sub(F *o, const F *a, const F *b) {
+    o->v[0] = a->v[0] + 0x7ffffffffffedULL * 4 - b->v[0];
+    o->v[1] = a->v[1] + 0x7ffffffffffffULL * 4 - b->v[1];
+    o->v[2] = a->v[2] + 0x7ffffffffffffULL * 4 - b->v[2];
+    o->v[3] = a->v[3] + 0x7ffffffffffffULL * 4 - b->v[3];
+    o->v[4] = a->v[4] + 0x7ffffffffffffULL * 4 - b->v[4];
+}
+
+static void carry(F *o) {
+    for (int r = 0; r < 3; r++) {
+        u64 c = 0;
+        for (int i = 0; i < 5; i++) {
+            u64 t = o->v[i] + c;
+            o->v[i] = t & MASK;
+            c = t >> 51;
+        }
+        o->v[0] += 19 * c;
+    }
+}
+
+static void mul(F *o, const F *a, const F *b) {
+    u128 t[5] = {0, 0, 0, 0, 0};
+    for (int i = 0; i < 5; i++) {
+        for (int j = 0; j < 5; j++) {
+            int k = i + j;
+            if (k < 5)
+                t[k] += (u128)a->v[i] * b->v[j];
+            else
+                t[k - 5] += (u128)a->v[i] * b->v[j] * 19;
+        }
+    }
+    u128 c = 0;
+    u64 r[5];
+    for (int i = 0; i < 5; i++) {
+        u128 v = t[i] + c;
+        r[i] = (u64)v & MASK;
+        c = v >> 51;
+    }
+    // top carry can reach ~2^63 with loose (sub-biased) inputs, so the
+    // 19-fold must run in 128-bit and ripple once into limb 1; limbs end
+    // < 2^51 + 2^17 — safely inside the next mul's accumulation bound
+    u128 fold = (u128)c * 19 + r[0];
+    o->v[0] = (u64)fold & MASK;
+    o->v[1] = r[1] + (u64)(fold >> 51);
+    o->v[2] = r[2];
+    o->v[3] = r[3];
+    o->v[4] = r[4];
+}
+
+static void sq(F *o, const F *a) { mul(o, a, a); }
+
+static void mul_small(F *o, const F *a, u64 s) {
+    u128 c = 0;
+    for (int i = 0; i < 5; i++) {
+        u128 v = (u128)a->v[i] * s + c;
+        o->v[i] = (u64)v & MASK;
+        c = v >> 51;
+    }
+    o->v[0] += 19 * (u64)c;
+    carry(o);
+}
+
+static void freeze(F *o) {
+    carry(o);
+    // conditional subtract p (possibly twice)
+    for (int r = 0; r < 2; r++) {
+        u64 t[5];
+        t[0] = o->v[0] - 0x7ffffffffffedULL;
+        u64 borrow = t[0] >> 63;
+        t[0] &= ~(1ULL << 63);
+        // do proper borrow chain
+        __int128 acc = (__int128)o->v[0] - 0x7ffffffffffedULL;
+        u64 res[5];
+        res[0] = (u64)acc & MASK;
+        acc >>= 51;
+        for (int i = 1; i < 5; i++) {
+            acc += (__int128)o->v[i] - 0x7ffffffffffffULL;
+            res[i] = (u64)acc & MASK;
+            acc >>= 51;
+        }
+        (void)borrow; (void)t;
+        if (acc == 0) memcpy(o->v, res, sizeof res);  // o >= p: keep result
+    }
+}
+
+static void to_bytes(u8 out[32], const F *a) {
+    F t = *a;
+    freeze(&t);
+    u64 limbs[5];
+    memcpy(limbs, t.v, sizeof limbs);
+    for (int i = 0; i < 32; i++) out[i] = 0;
+    int bit = 0;
+    for (int l = 0; l < 5; l++) {
+        for (int b = 0; b < 51; b++) {
+            if (limbs[l] >> b & 1) out[(bit + b) / 8] |= (u8)(1 << ((bit + b) % 8));
+        }
+        bit += 51;
+    }
+}
+
+static void from_bytes(F *o, const u8 in[32]) {
+    // little-endian, top bit masked by caller if needed
+    u64 limbs[5] = {0, 0, 0, 0, 0};
+    for (int i = 0; i < 255; i++) {
+        if (in[i / 8] >> (i % 8) & 1) limbs[i / 51] |= 1ULL << (i % 51);
+    }
+    memcpy(o->v, limbs, sizeof limbs);
+}
+
+static int is_zero(const F *a) {
+    F t = *a;
+    freeze(&t);
+    u64 acc = 0;
+    for (int i = 0; i < 5; i++) acc |= t.v[i];
+    return acc == 0;
+}
+
+static int eq(const F *a, const F *b) {
+    F d;
+    sub(&d, a, b);
+    carry(&d);
+    return is_zero(&d);
+}
+
+static int parity(const F *a) {
+    F t = *a;
+    freeze(&t);
+    return (int)(t.v[0] & 1);
+}
+
+// a^(2^252 - 3): shared exponent for invert + sqrt
+static void pow2523(F *o, const F *a) {
+    F x2, x9, x11, x31, t;
+    sq(&x2, a);                       // 2
+    sq(&t, &x2); sq(&t, &t);          // 8
+    mul(&x9, &t, a);                  // 9
+    mul(&x11, &x9, &x2);              // 11
+    sq(&t, &x11); mul(&x31, &t, &x9); // 2^5-1
+    F r = x31;
+    for (int i = 0; i < 5; i++) sq(&r, &r);
+    mul(&r, &r, &x31);                // 2^10-1
+    F r10 = r;
+    for (int i = 0; i < 10; i++) sq(&r, &r);
+    mul(&r, &r, &r10);                // 2^20-1
+    F r20 = r;
+    for (int i = 0; i < 20; i++) sq(&r, &r);
+    mul(&r, &r, &r20);                // 2^40-1
+    for (int i = 0; i < 10; i++) sq(&r, &r);
+    mul(&r, &r, &r10);                // 2^50-1
+    F r50 = r;
+    for (int i = 0; i < 50; i++) sq(&r, &r);
+    mul(&r, &r, &r50);                // 2^100-1
+    F r100 = r;
+    for (int i = 0; i < 100; i++) sq(&r, &r);
+    mul(&r, &r, &r100);               // 2^200-1
+    for (int i = 0; i < 50; i++) sq(&r, &r);
+    mul(&r, &r, &r50);                // 2^250-1
+    sq(&r, &r); sq(&r, &r);
+    mul(o, &r, a);                    // 2^252-3
+}
+
+static void invert(F *o, const F *a) {
+    F t;
+    pow2523(&t, a);  // a^(2^252-3)
+    sq(&t, &t); sq(&t, &t); sq(&t, &t);  // a^(2^255-24)
+    F a2, a3;
+    sq(&a2, a);
+    mul(&a3, &a2, a);
+    mul(o, &t, &a3);  // exponent 2^255-24+3 = p-2... (8*(2^252-3)+3)
+}
+
+}  // namespace fe
+
+// ------------------------------------------------- scalars mod L ---------
+namespace sc {
+
+// L = 2^252 + 27742317777372353535851937790883648493
+static const u64 L[4] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL,
+                         0, 0x1000000000000000ULL};
+
+// 256-bit big-endian-agnostic helpers over 4x64 LE words
+static int cmp(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static void sub(u64 o[4], const u64 a[4], const u64 b[4]) {
+    unsigned char borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)a[i] - b[i] - borrow;
+        o[i] = (u64)t;
+        borrow = (t >> 64) ? 1 : 0;
+    }
+}
+
+// reduce a 512-bit LE value mod L (binary shift-subtract; host path only)
+static void reduce512(u64 o[4], const u8 in[64]) {
+    // r = 0; for bits from msb: r = 2r + bit; if r >= L: r -= L
+    u64 r[4] = {0, 0, 0, 0};
+    for (int byte = 63; byte >= 0; byte--) {
+        for (int bit = 7; bit >= 0; bit--) {
+            // r <<= 1
+            u64 carry = 0;
+            for (int i = 0; i < 4; i++) {
+                u64 nc = r[i] >> 63;
+                r[i] = (r[i] << 1) | carry;
+                carry = nc;
+            }
+            r[0] |= (in[byte] >> bit) & 1;
+            if (carry || cmp(r, L) >= 0) sub(r, r, L);
+        }
+    }
+    memcpy(o, r, 32);
+}
+
+static void from_bytes(u64 o[4], const u8 in[32]) {
+    for (int i = 0; i < 4; i++) {
+        o[i] = 0;
+        for (int j = 0; j < 8; j++) o[i] |= (u64)in[8 * i + j] << (8 * j);
+    }
+}
+
+static void to_bytes(u8 out[32], const u64 a[4]) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) out[8 * i + j] = (u8)(a[i] >> (8 * j));
+}
+
+// o = (a*b + c) mod L — schoolbook into 512 bits then reduce
+static void muladd(u64 o[4], const u64 a[4], const u64 b[4], const u64 c[4]) {
+    u64 wide[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)a[i] * b[j] + wide[i + j] + carry;
+            wide[i + j] = (u64)t;
+            carry = t >> 64;
+        }
+        wide[i + 4] += (u64)carry;
+    }
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)wide[i] + c[i] + carry;
+        wide[i] = (u64)t;
+        carry = t >> 64;
+    }
+    for (int i = 4; i < 8 && carry; i++) {
+        u128 t = (u128)wide[i] + carry;
+        wide[i] = (u64)t;
+        carry = t >> 64;
+    }
+    u8 bytes[64];
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++) bytes[8 * i + j] = (u8)(wide[i] >> (8 * j));
+    reduce512(o, bytes);
+}
+
+}  // namespace sc
+
+// --------------------------------------------------- curve points --------
+namespace ge {
+
+using fe::F;
+
+struct P {
+    F x, y, z, t;
+};
+
+// d = -121665/121666
+static F D, D2, SQRTM1;
+static P BASE;
+static bool inited = false;
+
+static void identity(P *o) {
+    fe::set0(&o->x);
+    fe::set1(&o->y);
+    fe::set1(&o->z);
+    fe::set0(&o->t);
+}
+
+static void add(P *o, const P *p, const P *q) {
+    F a, b, c, d_, e, f, g, h, t0, t1;
+    fe::sub(&t0, &p->y, &p->x); fe::carry(&t0);
+    fe::sub(&t1, &q->y, &q->x); fe::carry(&t1);
+    fe::mul(&a, &t0, &t1);
+    fe::add(&t0, &p->y, &p->x);
+    fe::add(&t1, &q->y, &q->x);
+    fe::mul(&b, &t0, &t1);
+    fe::mul(&c, &p->t, &D2);
+    fe::mul(&c, &c, &q->t);
+    fe::mul(&d_, &p->z, &q->z);
+    fe::add(&d_, &d_, &d_);
+    fe::sub(&e, &b, &a); fe::carry(&e);
+    fe::sub(&f, &d_, &c); fe::carry(&f);
+    fe::add(&g, &d_, &c);
+    fe::add(&h, &b, &a);
+    fe::mul(&o->x, &e, &f);
+    fe::mul(&o->y, &g, &h);
+    fe::mul(&o->z, &f, &g);
+    fe::mul(&o->t, &e, &h);
+}
+
+static void dbl(P *o, const P *p) { add(o, p, p); }
+
+static void neg(P *o, const P *p) {
+    F zero;
+    fe::set0(&zero);
+    fe::sub(&o->x, &zero, &p->x); fe::carry(&o->x);
+    o->y = p->y;
+    o->z = p->z;
+    fe::sub(&o->t, &zero, &p->t); fe::carry(&o->t);
+}
+
+// o = [s]p, 4-bit windows msb-first
+static void scalar_mul(P *o, const u8 s[32], const P *p) {
+    P table[16];
+    identity(&table[0]);
+    table[1] = *p;
+    for (int i = 2; i < 16; i++) add(&table[i], &table[i - 1], p);
+    P r;
+    identity(&r);
+    for (int i = 31; i >= 0; i--) {
+        for (int half = 1; half >= 0; half--) {
+            int nib = (s[i] >> (4 * half)) & 15;
+            if (!(i == 31 && half == 1)) {
+                dbl(&r, &r); dbl(&r, &r); dbl(&r, &r); dbl(&r, &r);
+            }
+            if (nib) add(&r, &r, &table[nib]);
+        }
+    }
+    *o = r;
+}
+
+// ZIP-215 liberal decompression; returns 0 on failure
+static int decompress(P *o, const u8 in[32]) {
+    u8 yb[32];
+    memcpy(yb, in, 32);
+    int sign = yb[31] >> 7;
+    yb[31] &= 0x7f;
+    fe::from_bytes(&o->y, yb);  // NOT checked canonical: ZIP-215 liberal
+    F yy, u, v, v3, v7, t0, x, vxx;
+    fe::sq(&yy, &o->y);
+    F one;
+    fe::set1(&one);
+    fe::sub(&u, &yy, &one); fe::carry(&u);
+    fe::mul(&v, &yy, &D);
+    fe::add(&v, &v, &one); fe::carry(&v);
+    fe::sq(&v3, &v);
+    fe::mul(&v3, &v3, &v);
+    fe::sq(&v7, &v3);
+    fe::mul(&v7, &v7, &v);
+    fe::mul(&t0, &u, &v7);
+    fe::pow2523(&t0, &t0);
+    fe::mul(&x, &u, &v3);
+    fe::mul(&x, &x, &t0);
+    fe::sq(&vxx, &x);
+    fe::mul(&vxx, &vxx, &v);
+    F negu;
+    fe::set0(&negu);
+    fe::sub(&negu, &negu, &u); fe::carry(&negu);
+    if (!fe::eq(&vxx, &u)) {
+        if (!fe::eq(&vxx, &negu)) return 0;
+        fe::mul(&x, &x, &SQRTM1);
+    }
+    if (fe::parity(&x) != sign) {
+        F zero;
+        fe::set0(&zero);
+        fe::sub(&x, &zero, &x); fe::carry(&x);
+    }
+    o->x = x;
+    fe::set1(&o->z);
+    fe::mul(&o->t, &o->x, &o->y);
+    return 1;
+}
+
+static void compress(u8 out[32], const P *p) {
+    F zi, x, y;
+    fe::invert(&zi, &p->z);
+    fe::mul(&x, &p->x, &zi);
+    fe::mul(&y, &p->y, &zi);
+    fe::to_bytes(out, &y);
+    out[31] |= (u8)(fe::parity(&x) << 7);
+}
+
+static int is_identity(const P *p) {
+    return fe::is_zero(&p->x) && fe::eq(&p->y, &p->z);
+}
+
+static void init_constants() {
+    if (inited) return;
+    // d = -121665 * inv(121666)
+    F n121665, n121666, inv121666, zero;
+    fe::set0(&zero);
+    fe::set0(&n121665); n121665.v[0] = 121665;
+    fe::set0(&n121666); n121666.v[0] = 121666;
+    fe::invert(&inv121666, &n121666);
+    F d_;
+    fe::mul(&d_, &n121665, &inv121666);
+    fe::sub(&D, &zero, &d_); fe::carry(&D);
+    fe::add(&D2, &D, &D); fe::carry(&D2);
+    // sqrt(-1) = 2^((p-1)/4): compute via pow2523(-1)... use known bytes
+    static const u8 sqrtm1_bytes[32] = {
+        0xb0, 0xa0, 0x0e, 0x4a, 0x27, 0x1b, 0xee, 0xc4, 0x78, 0xe4, 0x2f,
+        0xad, 0x06, 0x18, 0x43, 0x2f, 0xa7, 0xd7, 0xfb, 0x3d, 0x99, 0x00,
+        0x4d, 0x2b, 0x0b, 0xdf, 0xc1, 0x4f, 0x80, 0x24, 0x83, 0x2b};
+    fe::from_bytes(&SQRTM1, sqrtm1_bytes);
+    // base point: y = 4/5
+    F four, five, inv5, by;
+    fe::set0(&four); four.v[0] = 4;
+    fe::set0(&five); five.v[0] = 5;
+    fe::invert(&inv5, &five);
+    fe::mul(&by, &four, &inv5);
+    u8 bb[32];
+    fe::to_bytes(bb, &by);  // sign bit 0 => even x
+    decompress(&BASE, bb);
+    inited = true;
+}
+
+}  // namespace ge
+
+// ------------------------------------------------------- public ABI ------
+extern "C" {
+
+// verify: ZIP-215. Returns 1 valid, 0 invalid.
+int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
+    ge::init_constants();
+    // S < L
+    u64 s_words[4], l_minus[4];
+    sc::from_bytes(s_words, sig + 32);
+    (void)l_minus;
+    if (sc::cmp(s_words, sc::L) >= 0) return 0;
+    ge::P A, R;
+    if (!ge::decompress(&A, pub)) return 0;
+    if (!ge::decompress(&R, sig)) return 0;
+    // k = SHA512(R || A || M) mod L
+    u8 digest[64];
+    sha512::hash(sig, 32, pub, 32, msg, msg_len, digest);
+    u64 k[4];
+    sc::reduce512(k, digest);
+    u8 kb[32], sb[32];
+    sc::to_bytes(kb, k);
+    memcpy(sb, sig + 32, 32);
+    // check [8]([S]B - [k]A - R) == identity
+    ge::P sB, kA, negkA, negR, acc;
+    ge::scalar_mul(&sB, sb, &ge::BASE);
+    ge::scalar_mul(&kA, kb, &A);
+    ge::neg(&negkA, &kA);
+    ge::neg(&negR, &R);
+    ge::add(&acc, &sB, &negkA);
+    ge::add(&acc, &acc, &negR);
+    ge::dbl(&acc, &acc);
+    ge::dbl(&acc, &acc);
+    ge::dbl(&acc, &acc);
+    return ge::is_identity(&acc);
+}
+
+// sign: RFC 8032. seed is 32 bytes; out sig is 64 bytes.
+void ed25519_sign(const u8 *seed, const u8 *pub, const u8 *msg, u64 msg_len,
+                  u8 *sig_out) {
+    ge::init_constants();
+    u8 h[64];
+    sha512::hash(seed, 32, nullptr, 0, nullptr, 0, h);
+    u8 a_clamped[32];
+    memcpy(a_clamped, h, 32);
+    a_clamped[0] &= 248;
+    a_clamped[31] &= 63;
+    a_clamped[31] |= 64;
+    // r = SHA512(prefix || msg) mod L
+    u8 rdig[64];
+    sha512::hash(h + 32, 32, msg, msg_len, nullptr, 0, rdig);
+    u64 r[4];
+    sc::reduce512(r, rdig);
+    u8 rb[32];
+    sc::to_bytes(rb, r);
+    ge::P Rp;
+    ge::scalar_mul(&Rp, rb, &ge::BASE);
+    u8 Renc[32];
+    ge::compress(Renc, &Rp);
+    // k = SHA512(R || A || M) mod L
+    u8 kdig[64];
+    sha512::hash(Renc, 32, pub, 32, msg, msg_len, kdig);
+    u64 k[4], a_words[4], s[4];
+    sc::reduce512(k, kdig);
+    // a mod L (clamped a < 2^255, reduce via 512-bit path)
+    u8 a64[64] = {0};
+    memcpy(a64, a_clamped, 32);
+    sc::reduce512(a_words, a64);
+    sc::muladd(s, k, a_words, r);  // s = k*a + r mod L
+    memcpy(sig_out, Renc, 32);
+    sc::to_bytes(sig_out + 32, s);
+}
+
+// pubkey from seed
+void ed25519_pubkey(const u8 *seed, u8 *pub_out) {
+    ge::init_constants();
+    u8 h[64];
+    sha512::hash(seed, 32, nullptr, 0, nullptr, 0, h);
+    u8 a[32];
+    memcpy(a, h, 32);
+    a[0] &= 248;
+    a[31] &= 63;
+    a[31] |= 64;
+    ge::P A;
+    ge::scalar_mul(&A, a, &ge::BASE);
+    ge::compress(pub_out, &A);
+}
+
+// sha512 for completeness (host tooling)
+void sha512_digest(const u8 *msg, u64 len, u8 *out) {
+    sha512::hash(msg, len, nullptr, 0, nullptr, 0, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+"""Native C++ Ed25519 engine: differential vs the pure-Python oracle
+(csrc/ed25519_native.cpp via ctypes; the reference's curve25519-voi
+assembly analogue for the host-side per-signature path)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.crypto import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+rng = np.random.default_rng(5)
+
+
+def test_native_differential_random():
+    for i in range(12):
+        seed = bytes(rng.bytes(32))
+        msg = bytes(rng.bytes(int(rng.integers(0, 300))))
+        pub = ref.pubkey_from_seed(seed)
+        assert native.pubkey(seed) == pub
+        sig = ref.sign(seed, msg)
+        assert native.sign(seed, pub, msg) == sig  # RFC 8032 deterministic
+        assert native.verify(pub, msg, sig)
+        assert not native.verify(pub, msg + b"x", sig)
+        bad = bytearray(sig)
+        bad[int(rng.integers(0, 64))] ^= 1 + int(rng.integers(0, 255))
+        if bytes(bad) != sig:
+            assert native.verify(pub, msg, bytes(bad)) == ref.verify(
+                pub, msg, bytes(bad)
+            )
+
+
+def test_native_zip215_edges():
+    # torsion pubkey with all-zero signature: ZIP-215 accepts
+    small = bytes.fromhex(
+        "c7176a703d4dd84fba3c0b760d10670f2a2053fa2c39ccc64ec7fd7792ac037a"
+    )
+    assert native.verify(small, b"m", bytes(64)) == ref.verify(
+        small, b"m", bytes(64)
+    )
+    # S >= L must be rejected
+    seed = b"\x09" * 32
+    pub = ref.pubkey_from_seed(seed)
+    sig = bytearray(ref.sign(seed, b"msg"))
+    sig[32:] = ref.L.to_bytes(32, "little")
+    assert not native.verify(pub, b"msg", bytes(sig))
+    # non-canonical A (y >= p) handled identically to the oracle
+    bad_a = (ref.P + 3).to_bytes(32, "little")
+    assert native.verify(bad_a, b"m", bytes(64)) == ref.verify(
+        bad_a, b"m", bytes(64)
+    )
+
+
+def test_key_classes_use_native():
+    from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    pk = Ed25519PrivKey(b"\x04" * 32)
+    sig = pk.sign(b"vote")
+    assert pk.pub_key().verify_signature(b"vote", sig)
+    assert not pk.pub_key().verify_signature(b"votes", sig)
+    # deterministic: matches the oracle exactly
+    assert sig == ref.sign(b"\x04" * 32, b"vote")
