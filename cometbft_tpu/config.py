@@ -52,6 +52,8 @@ class RPCConfig:
 class P2PConfig:
     laddr: str = "tcp://127.0.0.1:26656"
     persistent_peers: str = ""  # comma-separated host:port
+    pex: bool = True
+    addr_book_file: str = "config/addrbook.json"
     max_inbound_peers: int = 40
     max_outbound_peers: int = 10
     send_rate: int = 512_000  # bytes/s (reference 500 KB/s default)

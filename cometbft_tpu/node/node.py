@@ -166,6 +166,17 @@ class Node:
             self.app_conns.snapshot, self.statesync_pool
         )
         self.switch.add_reactor(self.statesync_reactor)
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from ..p2p.pex import AddrBook, PexReactor
+
+            self.addr_book = AddrBook(_p(config.p2p.addr_book_file))
+            self.pex_reactor = PexReactor(
+                self.addr_book,
+                target_outbound=config.p2p.max_outbound_peers,
+            )
+            self.pex_reactor.set_switch(self.switch)
+            self.switch.add_reactor(self.pex_reactor)
         self.rpc_env = Env(
             block_store=self.block_store,
             state_store=self.state_store,
@@ -211,6 +222,8 @@ class Node:
             except Exception:  # noqa: BLE001 — reference retries async
                 pass
         self.pruner.start()
+        if self.pex_reactor is not None:
+            self.pex_reactor.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
         self.consensus.start()
@@ -218,6 +231,8 @@ class Node:
     def stop(self) -> None:
         self.consensus.stop()
         self.pruner.stop()
+        if self.pex_reactor is not None:
+            self.pex_reactor.stop()
         self.consensus_reactor.stop()
         self.switch.stop()
         self.indexer_service.stop()

@@ -1,0 +1,291 @@
+"""Peer exchange: address book + PEX reactor.
+
+Behavior parity: reference p2p/pex/ — the AddrBook keeps "new" (heard
+about) and "old" (proven good) addresses with source tracking, random
+selection biased toward old entries, JSON persistence, and good/bad
+marking that promotes/demotes between the groups (addrbook.go). The
+reactor (pex_reactor.go) speaks channel 0x00: on AddPeer it asks for
+addresses, answers requests with a random selection, and an ensure-peers
+loop dials from the book when below the outbound target. Wire format
+matches the reference pex proto (Message oneof: pex_request=1,
+pex_addrs=2; NetAddress {id=1, ip=2, port=3}).
+
+The reference's 256-bucket hashed structure defends a large address
+space against poisoning; this keeps the same observable behavior
+(new/old split, biased selection, persistence) with flat groups — the
+bucket hashing is a scaling optimization documented as future work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from ..encoding import proto as pb
+from ..utils.log import logger
+from .conn import ChannelDescriptor
+from .switch import Reactor
+
+PEX_CHANNEL = 0x00
+MAX_ADDRS_PER_MSG = 100
+_log = logger("pex")
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    node_id: str
+    host: str
+    port: int
+
+    def encode(self) -> bytes:
+        return (
+            pb.f_string(1, self.node_id)
+            + pb.f_string(2, self.host)
+            + pb.f_varint(3, self.port)
+        )
+
+    @classmethod
+    def from_fields(cls, d: dict) -> "NetAddress":
+        return cls(
+            node_id=bytes(d.get(1, b"")).decode(),
+            host=bytes(d.get(2, b"")).decode(),
+            port=pb.to_i64(d.get(3, 0)),
+        )
+
+
+def encode_pex_request() -> bytes:
+    return pb.f_embedded(1, b"")
+
+
+def encode_pex_addrs(addrs: list[NetAddress]) -> bytes:
+    body = b"".join(pb.f_embedded(1, a.encode()) for a in addrs)
+    return pb.f_embedded(2, body)
+
+
+def decode_pex_message(buf: bytes):
+    d = pb.fields_to_dict(buf)
+    if 1 in d:
+        return "request", None
+    if 2 in d:
+        addrs = []
+        for f, _, v in pb.parse_fields(bytes(d[2])):
+            if f == 1:
+                addrs.append(NetAddress.from_fields(pb.fields_to_dict(bytes(v))))
+        return "addrs", addrs
+    return None, None
+
+
+class AddrBook:
+    """new/old address groups with persistence (reference pex/addrbook.go)."""
+
+    def __init__(self, path: str | None = None, max_new: int = 1024,
+                 max_old: int = 1024):
+        self._path = path
+        self._max_new = max_new
+        self._max_old = max_old
+        self._lock = threading.Lock()
+        self._new: dict[str, NetAddress] = {}
+        self._old: dict[str, NetAddress] = {}
+        self._attempts: dict[str, int] = {}
+        self._banned: set[str] = set()
+        if path and os.path.exists(path):
+            self._load()
+
+    # -- mutation ----------------------------------------------------------
+    def add_address(self, addr: NetAddress, source: str = "") -> bool:
+        """File a heard-about address into the new group."""
+        if not addr.node_id or not addr.host or not (0 < addr.port < 65536):
+            return False
+        with self._lock:
+            if addr.node_id in self._banned or addr.node_id in self._old:
+                return False
+            if addr.node_id in self._new:
+                return False
+            if len(self._new) >= self._max_new:
+                # evict the most-attempted new address (least promising)
+                victim = max(
+                    self._new,
+                    key=lambda k: self._attempts.get(k, 0),
+                )
+                del self._new[victim]
+            self._new[addr.node_id] = addr
+            return True
+
+    def mark_good(self, node_id: str) -> None:
+        """Promote to old after a successful outbound connection."""
+        with self._lock:
+            addr = self._new.pop(node_id, None)
+            if addr is None:
+                return
+            if len(self._old) >= self._max_old:
+                # demote a random old entry back to new
+                demote = random.choice(list(self._old))
+                self._new[demote] = self._old.pop(demote)
+            self._old[node_id] = addr
+            self._attempts.pop(node_id, None)
+
+    def mark_attempt(self, node_id: str) -> None:
+        with self._lock:
+            self._attempts[node_id] = self._attempts.get(node_id, 0) + 1
+
+    def mark_bad(self, node_id: str) -> None:
+        """Ban (evidence of misbehavior; reference MarkBad)."""
+        with self._lock:
+            self._new.pop(node_id, None)
+            self._old.pop(node_id, None)
+            self._banned.add(node_id)
+
+    # -- selection ---------------------------------------------------------
+    def pick_address(self, bias_old_pct: int = 70) -> NetAddress | None:
+        """Random address, biased toward proven-good entries."""
+        with self._lock:
+            use_old = self._old and (
+                not self._new or random.randrange(100) < bias_old_pct
+            )
+            group = self._old if use_old else self._new
+            if not group:
+                return None
+            return group[random.choice(list(group))]
+
+    def random_selection(self, n: int = MAX_ADDRS_PER_MSG) -> list[NetAddress]:
+        with self._lock:
+            pool = list(self._old.values()) + list(self._new.values())
+        random.shuffle(pool)
+        return pool[:n]
+
+    def has(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._new or node_id in self._old
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._new) + len(self._old)
+
+    # -- persistence -------------------------------------------------------
+    def save(self) -> None:
+        if not self._path:
+            return
+        with self._lock:
+            doc = {
+                "new": [a.__dict__ for a in self._new.values()],
+                "old": [a.__dict__ for a in self._old.values()],
+                "banned": sorted(self._banned),
+            }
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._path)
+
+    def _load(self) -> None:
+        try:
+            with open(self._path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        for a in doc.get("new", []):
+            self._new[a["node_id"]] = NetAddress(**a)
+        for a in doc.get("old", []):
+            self._old[a["node_id"]] = NetAddress(**a)
+        self._banned = set(doc.get("banned", []))
+
+
+class PexReactor(Reactor):
+    """Channel 0x00 address gossip + ensure-peers dialing loop."""
+
+    def __init__(self, book: AddrBook, target_outbound: int = 10,
+                 ensure_interval_s: float = 30.0):
+        self.book = book
+        self.target_outbound = target_outbound
+        self.ensure_interval_s = ensure_interval_s
+        self._switch = None
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._requested: set[str] = set()  # peers we asked (rate limit)
+
+    def set_switch(self, switch) -> None:
+        self._switch = switch
+
+    def channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1)]
+
+    def add_peer(self, peer) -> None:
+        # learn the peer's self-reported listen address
+        la = getattr(peer.node_info, "listen_addr", "")
+        if la and ":" in la:
+            host, _, port = la.rpartition(":")
+            try:
+                self.book.add_address(
+                    NetAddress(peer.id, host, int(port)), source=peer.id
+                )
+            except ValueError:
+                pass
+        if peer.outbound:
+            self.book.mark_good(peer.id)
+        peer.send(PEX_CHANNEL, encode_pex_request())
+        self._requested.add(peer.id)
+
+    def remove_peer(self, peer, reason) -> None:
+        self._requested.discard(peer.id)
+
+    def receive(self, chan_id: int, peer, raw: bytes) -> None:
+        kind, addrs = decode_pex_message(raw)
+        if kind == "request":
+            peer.send(
+                PEX_CHANNEL,
+                encode_pex_addrs(self.book.random_selection()),
+            )
+        elif kind == "addrs":
+            if peer.id not in self._requested:
+                # unsolicited addrs: the reference disconnects such peers
+                if self._switch is not None:
+                    self._switch.stop_peer_for_error(peer, "unsolicited pex")
+                return
+            self._requested.discard(peer.id)
+            for a in addrs[:MAX_ADDRS_PER_MSG]:
+                self.book.add_address(a, source=peer.id)
+
+    # -- ensure-peers loop -------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._ensure_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self.book.save()
+
+    def ensure_peers(self) -> None:
+        """Dial book addresses until the outbound target is met
+        (reference pex_reactor.go ensurePeers)."""
+        if self._switch is None:
+            return
+        out = sum(1 for p in self._switch.peers() if p.outbound)
+        tries = 0
+        while out < self.target_outbound and tries < 10:
+            tries += 1
+            addr = self.book.pick_address()
+            if addr is None:
+                return
+            if any(p.id == addr.node_id for p in self._switch.peers()):
+                continue
+            self.book.mark_attempt(addr.node_id)
+            try:
+                self._switch.dial_peer(addr.host, addr.port)
+                self.book.mark_good(addr.node_id)
+                out += 1
+            except Exception as e:  # noqa: BLE001 — dial failures expected
+                _log.debug("pex dial failed", peer=addr.node_id[:12],
+                           err=str(e)[:60])
+
+    def _ensure_loop(self) -> None:
+        while not self._stopped.wait(self.ensure_interval_s):
+            try:
+                self.ensure_peers()
+                self.book.save()
+            except Exception:  # noqa: BLE001
+                pass

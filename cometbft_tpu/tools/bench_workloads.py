@@ -1,0 +1,152 @@
+"""North-star workload benchmarks (BASELINE.json configs #2-#4).
+
+Runs the three system-level workloads behind the headline sigs/sec
+metric and prints one JSON line each:
+
+- verify-commit: types.VerifyCommit over an N-validator commit (#2)
+- light-stream: M SignedHeaders verified as one cross-header mega-batch
+  (workload #3, reference light/client_benchmark_test.go)
+- replay: block-sync replay of a stored chain, window mega-batching
+  (workload #4, reference internal/blocksync reactor loop)
+
+Usage: python -m cometbft_tpu.tools.bench_workloads [workload]
+  workload in {commit, light, replay, all}; sizes via flags below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_commit(n_validators: int, backend: str) -> dict:
+    from ..types.validation import verify_commit
+    from ..utils.factories import (
+        make_block_id,
+        make_commit,
+        make_signers,
+        make_validator_set,
+    )
+
+    signers = make_signers(n_validators)
+    vals = make_validator_set(signers)
+    bid = make_block_id(b"bench")
+    by_addr = {s.address(): s for s in signers}
+    commit = make_commit("bench-chain", 5, 0, bid, vals, by_addr)
+
+    verify_commit("bench-chain", vals, bid, 5, commit, backend=backend)  # warm
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        verify_commit("bench-chain", vals, bid, 5, commit, backend=backend)
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "metric": f"verify_commit_p50_{n_validators}v",
+        "value": round(dt * 1e3, 1),
+        "unit": "ms",
+        "sigs_per_sec": round(n_validators / dt, 1),
+    }
+
+
+def bench_light_stream(n_headers: int, n_validators: int, backend: str) -> dict:
+    from ..light import LightBlock, SignedHeader, verify_stream
+    from ..state.types import encode_validator_set
+    from ..storage import MemKV, StateStore
+    from ..types import Timestamp
+    from ..utils.factories import make_chain
+
+    store, state, _, _ = make_chain(
+        n_headers + 1, n_validators=n_validators,
+        chain_id="light-bench", backend=backend, txs_per_block=0,
+    )
+    ss = StateStore(MemKV())
+    for h in range(1, n_headers + 2):
+        ss._db.set(
+            b"SV:" + h.to_bytes(8, "big"),
+            encode_validator_set(state.validators),
+        )
+
+    def lb(h):
+        commit = store.load_block_commit(h) or store.load_seen_commit(h)
+        return LightBlock(
+            SignedHeader(store.load_block(h).header, commit),
+            state.validators,
+        )
+
+    trusted = lb(1)
+    stream = [lb(h) for h in range(2, n_headers + 2)]
+    now = Timestamp.from_unix_ns(
+        state.last_block_time.unix_ns() + 1_000_000_000
+    )
+    verify_stream("light-bench", trusted, stream, 10**9, now,
+                  backend=backend)  # warm
+    t0 = time.perf_counter()
+    verify_stream("light-bench", trusted, stream, 10**9, now, backend=backend)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": f"light_stream_{n_headers}h_{n_validators}v",
+        "value": round(dt, 3),
+        "unit": "s",
+        "headers_per_sec": round(n_headers / dt, 1),
+        "sigs_per_sec": round(n_headers * n_validators / dt, 1),
+    }
+
+
+def bench_replay(n_blocks: int, n_validators: int, backend: str) -> dict:
+    from ..abci.client import AppConns
+    from ..abci.kvstore import KVStoreApp
+    from ..blocksync import ReplayEngine
+    from ..state.execution import BlockExecutor
+    from ..utils.factories import make_chain
+
+    store, final_state, genesis, _ = make_chain(
+        n_blocks, n_validators=n_validators,
+        chain_id="replay-bench", backend=backend, txs_per_block=1,
+    )
+    # warm pass: compiles the window-batch bucket(s) once (persistent
+    # cache makes later runs cheap); timed pass measures steady state
+    warm = ReplayEngine(
+        store, BlockExecutor(AppConns(KVStoreApp()), backend=backend),
+        verify_mode="batched",
+    )
+    warm.run(genesis.copy())
+    engine = ReplayEngine(
+        store, BlockExecutor(AppConns(KVStoreApp()), backend=backend),
+        verify_mode="batched",
+    )
+    t0 = time.perf_counter()
+    state, stats = engine.run(genesis.copy())
+    dt = time.perf_counter() - t0
+    assert state.app_hash == final_state.app_hash, "replay diverged"
+    return {
+        "metric": f"replay_{n_blocks}b_{n_validators}v",
+        "value": round(dt, 3),
+        "unit": "s",
+        "blocks_per_sec": round(stats.blocks / dt, 1),
+        "sigs_per_sec": round(stats.sigs_verified / dt, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workload", nargs="?", default="all",
+                    choices=("commit", "light", "replay", "all"))
+    ap.add_argument("--validators", type=int, default=150)
+    ap.add_argument("--headers", type=int, default=1000)
+    ap.add_argument("--blocks", type=int, default=500)
+    ap.add_argument("--backend", default="tpu")
+    args = ap.parse_args(argv)
+    if args.workload in ("commit", "all"):
+        print(json.dumps(bench_commit(args.validators, args.backend)))
+    if args.workload in ("light", "all"):
+        print(json.dumps(bench_light_stream(args.headers, args.validators,
+                                            args.backend)))
+    if args.workload in ("replay", "all"):
+        print(json.dumps(bench_replay(args.blocks, args.validators,
+                                      args.backend)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -181,3 +181,86 @@ def test_switch_rejects_wrong_network():
             sw2.dial_peer(host, int(port))
     finally:
         sw1.stop(); sw2.stop()
+
+
+# ------------------------------------------------------------------ pex --
+def test_addrbook_groups_and_persistence(tmp_path):
+    from cometbft_tpu.p2p.pex import AddrBook, NetAddress
+
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path)
+    a1 = NetAddress("id1", "10.0.0.1", 26656)
+    a2 = NetAddress("id2", "10.0.0.2", 26656)
+    assert book.add_address(a1, "src") and book.add_address(a2, "src")
+    assert not book.add_address(a1, "src")  # dup
+    assert not book.add_address(NetAddress("", "x", 1))  # invalid
+    book.mark_good("id1")
+    assert book.size() == 2
+    book.mark_bad("id2")
+    assert book.size() == 1
+    assert not book.add_address(a2, "src")  # banned stays out
+    book.save()
+    book2 = AddrBook(path)
+    assert book2.has("id1") and not book2.has("id2")
+    assert book2.pick_address().node_id == "id1"
+
+
+def test_pex_wire_roundtrip():
+    from cometbft_tpu.p2p.pex import (
+        NetAddress,
+        decode_pex_message,
+        encode_pex_addrs,
+        encode_pex_request,
+    )
+
+    kind, _ = decode_pex_message(encode_pex_request())
+    assert kind == "request"
+    addrs = [NetAddress("n1", "1.2.3.4", 1000), NetAddress("n2", "::1", 2)]
+    kind, got = decode_pex_message(encode_pex_addrs(addrs))
+    assert kind == "addrs" and got == addrs
+
+
+def test_pex_gossip_and_dial(tmp_path):
+    """Three nodes: C knows only B; B knows A's address. After PEX
+    gossip + ensure_peers, C dials A (reference pex_reactor flow)."""
+    from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+
+    def make(name):
+        nk = NodeKey.generate()
+        info = NodeInfo(node_id=nk.node_id(), network="pex-chain", moniker=name)
+        tr = Transport(nk, info)
+        sw = Switch(tr)
+        book = AddrBook(str(tmp_path / f"{name}.json"))
+        pex = PexReactor(book, target_outbound=4)
+        pex.set_switch(sw)
+        sw.add_reactor(pex)
+        tr.listen()
+        sw.start()
+        return sw, tr, book, pex
+
+    sw_a, t_a, book_a, _ = make("a")
+    sw_b, t_b, book_b, pex_b = make("b")
+    sw_c, t_c, book_c, pex_c = make("c")
+    try:
+        host_a, port_a = t_a.node_info.listen_addr.split(":")
+        host_b, port_b = t_b.node_info.listen_addr.split(":")
+        # B learns A by dialing it
+        sw_b.dial_peer(host_a, int(port_a))
+        book_b.add_address(
+            __import__("cometbft_tpu.p2p.pex", fromlist=["NetAddress"]
+                       ).NetAddress(t_a.node_info.node_id, host_a, int(port_a)),
+            "manual",
+        )
+        # C dials B; pex request/response should teach C about A
+        sw_c.dial_peer(host_b, int(port_b))
+        deadline = time.monotonic() + 5
+        while not book_c.has(t_a.node_info.node_id) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert book_c.has(t_a.node_info.node_id), "C never learned A via PEX"
+        pex_c.ensure_peers()
+        deadline = time.monotonic() + 5
+        while len(sw_c.peers()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert any(p.id == t_a.node_info.node_id for p in sw_c.peers())
+    finally:
+        sw_a.stop(); sw_b.stop(); sw_c.stop()
