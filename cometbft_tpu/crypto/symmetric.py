@@ -1,0 +1,225 @@
+"""Symmetric crypto: Salsa20/ChaCha20 family + Poly1305, pure host-side.
+
+Behavior parity:
+- reference crypto/xsalsa20symmetric/symmetric.go: EncryptSymmetric =
+  random 24-byte nonce ‖ NaCl secretbox (XSalsa20-Poly1305); secret must
+  be exactly 32 bytes (e.g. SHA256(bcrypt(passphrase))).
+- reference crypto/xchacha20poly1305: the XChaCha20-Poly1305 AEAD
+  (HChaCha20 subkey + 8-byte-tail nonce ChaCha20-Poly1305).
+
+All primitives implemented from their specs (Salsa20/ChaCha20 quarter
+rounds, RFC 8439 Poly1305/AEAD layout, draft-irtf-cfrg-xchacha HChaCha20)
+and validated in tests against RFC vectors plus the `cryptography`
+package's independent ChaCha20-Poly1305.
+"""
+
+from __future__ import annotations
+
+import secrets
+import struct
+
+MASK32 = 0xFFFFFFFF
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & MASK32
+
+
+# ------------------------------------------------------------- salsa20 ----
+def _salsa20_core(state16: list[int], rounds: int = 20) -> list[int]:
+    x = list(state16)
+
+    def qr(a, b, c, d):
+        x[b] ^= _rotl((x[a] + x[d]) & MASK32, 7)
+        x[c] ^= _rotl((x[b] + x[a]) & MASK32, 9)
+        x[d] ^= _rotl((x[c] + x[b]) & MASK32, 13)
+        x[a] ^= _rotl((x[d] + x[c]) & MASK32, 18)
+
+    for _ in range(rounds // 2):
+        qr(0, 4, 8, 12); qr(5, 9, 13, 1); qr(10, 14, 2, 6); qr(15, 3, 7, 11)
+        qr(0, 1, 2, 3); qr(5, 6, 7, 4); qr(10, 11, 8, 9); qr(15, 12, 13, 14)
+    return x
+
+
+_SIGMA = struct.unpack("<4I", b"expand 32-byte k")
+
+
+def _salsa20_block(key: bytes, nonce16: bytes) -> bytes:
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", nonce16)
+    s = [_SIGMA[0], *k[:4], _SIGMA[1], *n, _SIGMA[2], *k[4:], _SIGMA[3]]
+    out = _salsa20_core(s)
+    return struct.pack("<16I", *((a + b) & MASK32 for a, b in zip(out, s)))
+
+
+def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """32-byte subkey from the core WITHOUT the feedforward (key rows)."""
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", nonce16)
+    s = [_SIGMA[0], *k[:4], _SIGMA[1], *n, _SIGMA[2], *k[4:], _SIGMA[3]]
+    z = _salsa20_core(s)
+    picks = [z[0], z[5], z[10], z[15], z[6], z[7], z[8], z[9]]
+    return struct.pack("<8I", *picks)
+
+
+def xsalsa20_stream(key: bytes, nonce24: bytes, length: int,
+                    counter: int = 0) -> bytes:
+    subkey = hsalsa20(key, nonce24[:16])
+    out = bytearray()
+    block_nonce = nonce24[16:24]
+    i = counter
+    while len(out) < length:
+        n16 = block_nonce + struct.pack("<Q", i)
+        out += _salsa20_block(subkey, n16)
+        i += 1
+    return bytes(out[:length])
+
+
+# ------------------------------------------------------------ poly1305 ----
+def poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little")
+    r &= 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        blk = msg[i : i + 16]
+        n = int.from_bytes(blk, "little") + (1 << (8 * len(blk)))
+        acc = (acc + n) * r % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+# ------------------------------------------------- NaCl secretbox --------
+SECRETBOX_OVERHEAD = 16
+NONCE_LEN = 24
+SECRET_LEN = 32
+
+
+def secretbox_seal(plaintext: bytes, nonce24: bytes, key: bytes) -> bytes:
+    """XSalsa20-Poly1305: tag ‖ ciphertext (NaCl box layout)."""
+    stream = xsalsa20_stream(key, nonce24, 32 + len(plaintext))
+    poly_key, pad = stream[:32], stream[32:]
+    ct = bytes(a ^ b for a, b in zip(plaintext, pad))
+    tag = poly1305(poly_key, ct)
+    return tag + ct
+
+
+def secretbox_open(boxed: bytes, nonce24: bytes, key: bytes) -> bytes | None:
+    if len(boxed) < SECRETBOX_OVERHEAD:
+        return None
+    tag, ct = boxed[:16], boxed[16:]
+    stream = xsalsa20_stream(key, nonce24, 32 + len(ct))
+    poly_key, pad = stream[:32], stream[32:]
+    if not secrets.compare_digest(tag, poly1305(poly_key, ct)):
+        return None
+    return bytes(a ^ b for a, b in zip(ct, pad))
+
+
+class ErrInvalidCiphertextLen(Exception):
+    pass
+
+
+class ErrCiphertextDecryption(Exception):
+    pass
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """nonce(24) ‖ secretbox(plaintext) — reference EncryptSymmetric."""
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be {SECRET_LEN} bytes")
+    nonce = secrets.token_bytes(NONCE_LEN)
+    return nonce + secretbox_seal(plaintext, nonce, secret)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"secret must be {SECRET_LEN} bytes")
+    if len(ciphertext) <= SECRETBOX_OVERHEAD + NONCE_LEN:
+        raise ErrInvalidCiphertextLen
+    out = secretbox_open(ciphertext[NONCE_LEN:], ciphertext[:NONCE_LEN], secret)
+    if out is None:
+        raise ErrCiphertextDecryption
+    return out
+
+
+# --------------------------------------------------------- chacha20 -------
+def _chacha20_core(state16: list[int], rounds: int = 20) -> list[int]:
+    x = list(state16)
+
+    def qr(a, b, c, d):
+        x[a] = (x[a] + x[b]) & MASK32; x[d] = _rotl(x[d] ^ x[a], 16)
+        x[c] = (x[c] + x[d]) & MASK32; x[b] = _rotl(x[b] ^ x[c], 12)
+        x[a] = (x[a] + x[b]) & MASK32; x[d] = _rotl(x[d] ^ x[a], 8)
+        x[c] = (x[c] + x[d]) & MASK32; x[b] = _rotl(x[b] ^ x[c], 7)
+
+    for _ in range(rounds // 2):
+        qr(0, 4, 8, 12); qr(1, 5, 9, 13); qr(2, 6, 10, 14); qr(3, 7, 11, 15)
+        qr(0, 5, 10, 15); qr(1, 6, 11, 12); qr(2, 7, 8, 13); qr(3, 4, 9, 14)
+    return x
+
+
+def _chacha20_block(key: bytes, counter: int, nonce12: bytes) -> bytes:
+    s = [*_SIGMA, *struct.unpack("<8I", key), counter & MASK32,
+         *struct.unpack("<3I", nonce12)]
+    out = _chacha20_core(s)
+    return struct.pack("<16I", *((a + b) & MASK32 for a, b in zip(out, s)))
+
+
+def chacha20_stream(key: bytes, nonce12: bytes, length: int,
+                    counter: int = 1) -> bytes:
+    out = bytearray()
+    i = counter
+    while len(out) < length:
+        out += _chacha20_block(key, i, nonce12)
+        i += 1
+    return bytes(out[:length])
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    s = [*_SIGMA, *struct.unpack("<8I", key), *struct.unpack("<4I", nonce16)]
+    z = _chacha20_core(s)
+    return struct.pack("<8I", *(z[:4] + z[12:16]))
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+def _aead_tag(key: bytes, nonce12: bytes, aad: bytes, ct: bytes) -> bytes:
+    poly_key = _chacha20_block(key, 0, nonce12)[:32]
+    mac_data = (aad + _pad16(aad) + ct + _pad16(ct)
+                + struct.pack("<Q", len(aad)) + struct.pack("<Q", len(ct)))
+    return poly1305(poly_key, mac_data)
+
+
+def chacha20poly1305_seal(key: bytes, nonce12: bytes, plaintext: bytes,
+                          aad: bytes = b"") -> bytes:
+    ct = bytes(a ^ b for a, b in zip(
+        plaintext, chacha20_stream(key, nonce12, len(plaintext))))
+    return ct + _aead_tag(key, nonce12, aad, ct)
+
+
+def chacha20poly1305_open(key: bytes, nonce12: bytes, boxed: bytes,
+                          aad: bytes = b"") -> bytes | None:
+    if len(boxed) < 16:
+        return None
+    ct, tag = boxed[:-16], boxed[-16:]
+    if not secrets.compare_digest(tag, _aead_tag(key, nonce12, aad, ct)):
+        return None
+    return bytes(a ^ b for a, b in zip(
+        ct, chacha20_stream(key, nonce12, len(ct))))
+
+
+def xchacha20poly1305_seal(key: bytes, nonce24: bytes, plaintext: bytes,
+                           aad: bytes = b"") -> bytes:
+    """reference crypto/xchacha20poly1305 New().Seal."""
+    subkey = hchacha20(key, nonce24[:16])
+    nonce12 = b"\x00" * 4 + nonce24[16:]
+    return chacha20poly1305_seal(subkey, nonce12, plaintext, aad)
+
+
+def xchacha20poly1305_open(key: bytes, nonce24: bytes, boxed: bytes,
+                           aad: bytes = b"") -> bytes | None:
+    subkey = hchacha20(key, nonce24[:16])
+    nonce12 = b"\x00" * 4 + nonce24[16:]
+    return chacha20poly1305_open(subkey, nonce12, boxed, aad)

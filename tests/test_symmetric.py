@@ -1,0 +1,95 @@
+"""Symmetric crypto + armor (reference crypto/xsalsa20symmetric,
+crypto/xchacha20poly1305, crypto/armor)."""
+
+import pytest
+
+from cometbft_tpu.crypto import symmetric as S
+from cometbft_tpu.crypto.armor import ArmorError, decode_armor, encode_armor
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a8"
+        "0103808afb0db2fd4abff6af4149f51b"
+    )
+    msg = b"Cryptographic Forum Research Group"
+    assert S.poly1305(key, msg).hex() == "a8061dc1305136c6c22b8baf0c0127a9"
+
+
+def test_chacha20poly1305_matches_cryptography():
+    """Cross-check the from-spec AEAD against an independent impl."""
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        key = bytes(rng.bytes(32))
+        nonce = bytes(rng.bytes(12))
+        msg = bytes(rng.bytes(int(rng.integers(0, 200))))
+        aad = bytes(rng.bytes(int(rng.integers(0, 40))))
+        ours = S.chacha20poly1305_seal(key, nonce, msg, aad)
+        theirs = ChaCha20Poly1305(key).encrypt(nonce, msg, aad)
+        assert ours == theirs
+        assert S.chacha20poly1305_open(key, nonce, ours, aad) == msg
+        assert S.chacha20poly1305_open(key, nonce, ours, aad + b"x") is None
+
+
+def test_hchacha20_draft_vector():
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    out = S.hchacha20(key, nonce)
+    assert out.hex() == (
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc"
+    )
+
+
+def test_xchacha20poly1305_roundtrip_and_tamper():
+    key = b"\x07" * 32
+    nonce = b"\x21" * 24
+    msg = b"the quick brown fox"
+    box = S.xchacha20poly1305_seal(key, nonce, msg, b"aad")
+    assert S.xchacha20poly1305_open(key, nonce, box, b"aad") == msg
+    assert S.xchacha20poly1305_open(key, nonce, box, b"bad") is None
+    broken = bytearray(box)
+    broken[0] ^= 1
+    assert S.xchacha20poly1305_open(key, nonce, bytes(broken), b"aad") is None
+
+
+def test_xsalsa_encrypt_symmetric_roundtrip():
+    secret = b"\x42" * 32
+    msg = b"priv-validator-key"
+    ct = S.encrypt_symmetric(msg, secret)
+    assert len(ct) == len(msg) + S.NONCE_LEN + S.SECRETBOX_OVERHEAD
+    assert S.decrypt_symmetric(ct, secret) == msg
+    # wrong key, corrupted box, truncated
+    with pytest.raises(S.ErrCiphertextDecryption):
+        S.decrypt_symmetric(ct, b"\x43" * 32)
+    broken = bytearray(ct)
+    broken[30] ^= 1
+    with pytest.raises(S.ErrCiphertextDecryption):
+        S.decrypt_symmetric(bytes(broken), secret)
+    with pytest.raises(S.ErrInvalidCiphertextLen):
+        S.decrypt_symmetric(ct[:30], secret)
+    with pytest.raises(ValueError):
+        S.encrypt_symmetric(msg, b"short")
+
+
+def test_armor_roundtrip_and_crc():
+    data = bytes(range(100))
+    headers = {"kdf": "bcrypt", "salt": "ABCDEF"}
+    s = encode_armor("TENDERMINT PRIVATE KEY", headers, data)
+    bt, hd, out = decode_armor(s)
+    assert bt == "TENDERMINT PRIVATE KEY"
+    assert hd == headers and out == data
+    # corrupt a body character -> CRC failure
+    lines = s.split("\n")
+    body_idx = next(i for i, ln in enumerate(lines)
+                    if ln and not ln.startswith("-") and ":" not in ln)
+    ch = "A" if lines[body_idx][0] != "A" else "B"
+    lines[body_idx] = ch + lines[body_idx][1:]
+    with pytest.raises(ArmorError):
+        decode_armor("\n".join(lines))
+    with pytest.raises(ArmorError):
+        decode_armor("not armor at all")
