@@ -142,3 +142,102 @@ def _trails_from_byte_slices(items: list[bytes]):
     right_root.parent = root
     right_root.left = left_root
     return lefts + rights, root
+
+
+# ---------------------------------------------------------------------------
+# Multi-op proof chains (reference crypto/merkle/proof_op.go +
+# proof_key_path.go): a query response proves value -> store root ->
+# app hash through a series of chained Merkle trees; each operator maps
+# its input leaves to the root of its tree, consuming one key-path
+# segment, and the final output must equal the trusted root.
+
+class ProofError(Exception):
+    pass
+
+
+class ProofOperator:
+    """One link: Run(leaves) -> [intermediate root]; key() names the
+    key-path segment it consumes ('' = keyless)."""
+
+    OP_TYPE = ""
+
+    def key(self) -> bytes:
+        return b""
+
+    def run(self, leaves: list[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOperator):
+    """Leaf value under `key` proven into a simple-merkle root
+    (reference proof_value.go): leaf = sha256(varint-ish encode of
+    key/value per tmhash convention — here leaf_hash of key ‖ value
+    hash, matching our tree's leaf rule over encoded pairs)."""
+
+    OP_TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self._key = key
+        self.proof = proof
+
+    def key(self) -> bytes:
+        return self._key
+
+    def run(self, leaves: list[bytes]) -> list[bytes]:
+        if len(leaves) != 1:
+            raise ProofError("ValueOp takes exactly one leaf")
+        vhash = _sha256(leaves[0])
+        leaf = leaf_hash(self._key + vhash)
+        root = _root_from_aunts(
+            self.proof.index, self.proof.total, leaf, self.proof.aunts
+        )
+        if root is None:
+            raise ProofError("bad value proof")
+        return [root]
+
+
+class HashOp(ProofOperator):
+    """Keyless link: input proven as a leaf of a parent tree
+    (e.g. store root -> app hash via proofs_from_byte_slices)."""
+
+    OP_TYPE = "simple:h"
+
+    def __init__(self, proof: Proof):
+        self.proof = proof
+
+    def run(self, leaves: list[bytes]) -> list[bytes]:
+        if len(leaves) != 1:
+            raise ProofError("HashOp takes exactly one leaf")
+        leaf = leaf_hash(leaves[0])
+        root = _root_from_aunts(
+            self.proof.index, self.proof.total, leaf, self.proof.aunts
+        )
+        if root is None:
+            raise ProofError("bad hash proof")
+        return [root]
+
+
+def verify_ops(ops: list[ProofOperator], root: bytes, keypath: list[bytes],
+               value: bytes) -> None:
+    """Apply operators innermost-first; each keyed op consumes the LAST
+    remaining key-path segment (reference ProofOperators.Verify); the
+    final output must equal `root` with the path fully consumed."""
+    keys = list(keypath)
+    args = [value]
+    for op in ops:
+        k = op.key()
+        if k:
+            if not keys:
+                raise ProofError("key path exhausted")
+            if keys[-1] != k:
+                raise ProofError(
+                    f"key mismatch: op consumes {k!r}, path has {keys[-1]!r}"
+                )
+            keys.pop()
+        args = op.run(args)
+    if not keys:
+        pass
+    else:
+        raise ProofError("key path not fully consumed")
+    if args[0] != root:
+        raise ProofError("proof root does not match trusted root")

@@ -41,3 +41,44 @@ def test_proof_sizes():
         for p in proofs:
             assert p.total == n
             assert p.compute_root() == root
+
+
+def test_proof_operators_chain():
+    import pytest
+
+    """value -> store root -> app hash through two chained trees
+    (reference proof_op.go ProofOperators.Verify)."""
+    import hashlib
+
+    from cometbft_tpu.crypto.merkle import (
+        HashOp,
+        ProofError,
+        ValueOp,
+        leaf_hash,
+        proofs_from_byte_slices,
+        verify_ops,
+    )
+
+    # store "acc": keys -> sha256(value) committed in a simple tree
+    items = []
+    kvs = [(b"k%d" % i, b"value-%d" % i) for i in range(7)]
+    for k, v in kvs:
+        items.append(k + hashlib.sha256(v).digest())
+    store_root, proofs = proofs_from_byte_slices(items)
+
+    # app hash commits the store roots
+    stores = [b"other-root-1", store_root, b"other-root-2"]
+    app_hash, store_proofs = proofs_from_byte_slices(stores)
+
+    key, value = kvs[3]
+    ops = [ValueOp(key, proofs[3]), HashOp(store_proofs[1])]
+    verify_ops(ops, app_hash, [key], value)
+    # wrong value fails
+    with pytest.raises(ProofError):
+        verify_ops(ops, app_hash, [key], b"forged")
+    # wrong root fails
+    with pytest.raises(ProofError):
+        verify_ops(ops, b"\x00" * 32, [key], value)
+    # unconsumed path fails
+    with pytest.raises(ProofError):
+        verify_ops(ops, app_hash, [b"extra", key], value)
