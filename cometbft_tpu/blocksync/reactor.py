@@ -132,14 +132,18 @@ class BlockSyncReactor(Reactor):
             peers = list(self._peers.values())
         for p in peers:
             p.send(BLOCKSYNC_CHANNEL, encode_status_request())
-        deadline = _time.monotonic() + timeout_s
+        start = _time.monotonic()
+        deadline = start + timeout_s
         applied = 0
         while _time.monotonic() < deadline:
             self.pool.make_requests()
             first, second = self.pool.peek_two_blocks()
             if first is None or second is None:
-                if applied and self.pool.is_caught_up():
-                    break
+                if self.pool.is_caught_up():
+                    break  # nothing (more) to fetch
+                if (self.pool.max_peer_height() == 0
+                        and _time.monotonic() - start > 3.0):
+                    break  # no peer ever reported a range
                 self.pool.wait_for_blocks(poll_s)
                 continue
             bid = block_id_for(first)

@@ -187,6 +187,23 @@ class ConsensusState:
             )
         self.last_commit = vs
 
+    def reset_to_state(self, sm_state) -> None:
+        """Re-anchor a not-yet-started instance to a newer state (the
+        block-sync / state-sync → consensus hand-off; reference
+        SwitchToConsensus, consensus/reactor.go:113)."""
+        if self._thread is not None:
+            raise RuntimeError("cannot reset a running consensus instance")
+        self.sm_state = sm_state
+        self.height = sm_state.last_block_height + 1
+        self.round = 0
+        self.step = RoundStep.NEW_HEIGHT
+        self.validators = sm_state.validators.copy()
+        self.votes = HeightVoteSet(self.chain_id, self.height, self.validators)
+        self.last_commit = None
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_id = None
+
     def start(self, replay_wal: bool = True) -> None:
         if self.last_commit is None and self.height > self.sm_state.initial_height:
             self.reconstruct_last_commit()

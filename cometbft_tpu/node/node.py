@@ -165,6 +165,15 @@ class Node:
         self.statesync_reactor = StateSyncReactor(
             self.app_conns.snapshot, self.statesync_pool
         )
+        from ..blocksync.reactor import BlockSyncReactor
+
+        self.blocksync_reactor = BlockSyncReactor(
+            self.block_store,
+            executor=self.executor,
+            state=sm_state,
+            backend=config.base.crypto_backend,
+        )
+        self.switch.add_reactor(self.blocksync_reactor)
         self.switch.add_reactor(self.statesync_reactor)
         self.pex_reactor = None
         if config.p2p.pex:
@@ -226,6 +235,18 @@ class Node:
             self.pex_reactor.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
+        # catch up over block sync before consensus when we have peers
+        # that are ahead (reference SwitchToConsensus hand-off)
+        if self.config.blocksync.enable and self.switch.peers():
+            import time as _time
+
+            _time.sleep(0.3)  # allow status exchange on fresh conns
+            try:
+                synced = self.blocksync_reactor.sync(timeout_s=30)
+                if synced.last_block_height > self.consensus.sm_state.last_block_height:
+                    self.consensus.reset_to_state(synced)
+            except Exception:  # noqa: BLE001 — fall through to consensus
+                pass
         self.consensus.start()
 
     def stop(self) -> None:
