@@ -236,7 +236,8 @@ class SocketClient:
     def check_tx(self, tx: bytes) -> T.CheckTxResult:
         return W.dec_check_tx_resp(self._call(W.CHECK_TX, tx))
 
-    def prepare_proposal(self, txs: list[bytes], max_tx_bytes: int) -> list[bytes]:
+    def prepare_proposal(self, txs: list[bytes], max_tx_bytes: int,
+                         local_last_commit=None) -> list[bytes]:
         from ..encoding import proto as pb
 
         payload = pb.f_embedded(1, W.enc_tx_list(txs)) + pb.f_varint(2, max_tx_bytes)

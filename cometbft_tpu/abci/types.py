@@ -185,7 +185,11 @@ class Application(ABC):
     def init_chain(self, req: InitChainRequest) -> InitChainResponse:
         return InitChainResponse()
 
-    def prepare_proposal(self, txs: list[bytes], max_tx_bytes: int) -> list[bytes]:
+    def prepare_proposal(self, txs: list[bytes], max_tx_bytes: int,
+                         local_last_commit=None) -> list[bytes]:
+        """local_last_commit: ExtendedCommit with the vote extensions the
+        app attached at height-1 (None while extensions are disabled) —
+        reference PrepareProposalRequest.LocalLastCommit."""
         out, total = [], 0
         for tx in txs:
             total += len(tx)

@@ -33,11 +33,11 @@ FAST_TIMEOUTS = TimeoutConfig(
 
 class InProcessNode:
     def __init__(self, idx, pv, chain_id, genesis, wal_path, net, timeouts,
-                 tx_source=None):
+                 tx_source=None, app_factory=None):
         self.idx = idx
         self.pv = pv
         self.net = net
-        self.app = KVStoreApp()
+        self.app = app_factory() if app_factory is not None else KVStoreApp()
         self.block_store = BlockStore(MemKV())
         self.state_store = StateStore(MemKV())
         conns = AppConns(self.app)
@@ -73,8 +73,10 @@ class InProcessNetwork:
     """N validators, full-mesh instant delivery (loopback)."""
 
     def __init__(self, n: int, tmpdir: str, chain_id: str = "loop-chain",
-                 timeouts: TimeoutConfig = FAST_TIMEOUTS, power: int = 10):
+                 timeouts: TimeoutConfig = FAST_TIMEOUTS, power: int = 10,
+                 consensus_params=None, app_factory=None):
         self.chain_id = chain_id
+        self.app_factory = app_factory
         self.pvs = [
             FilePV.generate(
                 os.path.join(tmpdir, f"pv{i}.key.json"),
@@ -86,10 +88,17 @@ class InProcessNetwork:
             [Validator.from_pub_key(pv.pub_key(), power) for pv in self.pvs]
         )
         self.genesis = make_genesis_state(chain_id, vals)
+        if consensus_params is not None:
+            from dataclasses import replace as _replace
+
+            self.genesis = _replace(
+                self.genesis, consensus_params=consensus_params
+            )
         self.nodes = [
             InProcessNode(
                 i, self.pvs[i], chain_id, self.genesis,
                 os.path.join(tmpdir, f"wal{i}"), self, timeouts,
+                app_factory=app_factory,
             )
             for i in range(n)
         ]

@@ -187,6 +187,12 @@ class ConsensusState:
             )
         self.last_commit = vs
 
+    def extensions_enabled(self, height: int) -> bool:
+        """Vote extensions active at `height` (reference
+        ConsensusParams.ABCI.VoteExtensionsEnabled)."""
+        eh = self.sm_state.consensus_params.abci.vote_extensions_enable_height
+        return eh > 0 and height >= eh
+
     def reset_to_state(self, sm_state) -> None:
         """Re-anchor a not-yet-started instance to a newer state (the
         block-sync / state-sync → consensus hand-off; reference
@@ -356,6 +362,16 @@ class ConsensusState:
             return
         if v.height != self.height:
             return
+        if (
+            v.type == SignedMsgType.PRECOMMIT
+            and not v.is_nil()
+            and self.extensions_enabled(self.height)
+            and peer_id != ""
+        ):
+            # reference addVote: peers' precommits must carry a valid
+            # extension signature AND pass the app's VerifyVoteExtension
+            if not self._verify_vote_extension(v):
+                return
         try:
             added = self.votes.add_vote(v, peer_id)
         except ErrVoteConflictingVotes as e:
@@ -377,6 +393,22 @@ class ConsensusState:
             self._after_prevote(v)
         else:
             self._after_precommit(v)
+
+    def _verify_vote_extension(self, v: Vote) -> bool:
+        _, val = self.validators.get_by_address(v.validator_address)
+        if val is None:
+            return False
+        if not v.extension_signature:
+            return False
+        if not val.pub_key.verify_signature(
+            v.extension_sign_bytes(self.chain_id), v.extension_signature
+        ):
+            return False
+        return bool(
+            self.executor.app.consensus.verify_vote_extension(
+                v.height, v.validator_address, v.extension
+            )
+        )
 
     def _after_prevote(self, v: Vote) -> None:
         prevotes = self.votes.prevotes(v.round)
@@ -661,6 +693,10 @@ class ConsensusState:
         seen_commit = precommits.make_commit()
         if self.block_store is not None:
             self.block_store.save_block(block, seen_commit)
+            if self.extensions_enabled(h):
+                self.block_store.save_extended_commit(
+                    precommits.make_extended_commit()
+                )
         self.wal.write_end_height(h)
         new_state = self.executor.apply_block(
             self.sm_state, maj, block,
@@ -727,7 +763,18 @@ class ConsensusState:
             validator_address=val.address,
             validator_index=idx,
         )
-        self.privval.sign_vote(self.chain_id, vote)
+        extend = (
+            vtype == SignedMsgType.PRECOMMIT
+            and not vote.is_nil()
+            and self.extensions_enabled(self.height)
+        )
+        if extend:
+            # app-supplied extension rides the precommit
+            # (reference state.go signVote -> ExtendVote)
+            vote.extension = self.executor.app.consensus.extend_vote(
+                self.height, self.round, vote.block_id.hash
+            )
+        self.privval.sign_vote(self.chain_id, vote, sign_extension=extend)
         if not self._replay_mode:
             self.broadcast(VoteMessage(vote))
         self.send(VoteMessage(vote), "")

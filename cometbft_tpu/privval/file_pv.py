@@ -44,6 +44,8 @@ class SignStep(enum.IntEnum):
     PRECOMMIT = 3
 
 
+_PRECOMMIT_TYPE = SignedMsgType.PRECOMMIT
+
 _VOTE_TO_STEP = {
     SignedMsgType.PREVOTE: SignStep.PREVOTE,
     SignedMsgType.PRECOMMIT: SignStep.PRECOMMIT,
@@ -144,8 +146,23 @@ class FilePV:
     def address(self) -> bytes:
         return self.pub_key().address()
 
-    def sign_vote(self, chain_id: str, vote) -> None:
-        """Sign a Vote in place (reference signVote :306)."""
+    def sign_vote(self, chain_id: str, vote, sign_extension: bool = False) -> None:
+        """Sign a Vote in place (reference signVote :306). With
+        sign_extension (precommits while vote extensions are enabled) the
+        extension gets its own signature over the canonical extension
+        sign-bytes — double-sign protection covers only the vote itself,
+        matching the reference (extensions are deterministic app data)."""
+        self._sign_vote_inner(chain_id, vote)
+        if (
+            sign_extension
+            and not vote.is_nil()
+            and vote.type == _PRECOMMIT_TYPE
+        ):
+            vote.extension_signature = self._priv.sign(
+                vote.extension_sign_bytes(chain_id)
+            )
+
+    def _sign_vote_inner(self, chain_id: str, vote) -> None:
         step = _VOTE_TO_STEP.get(vote.type)
         if step is None:
             raise ValueError(f"unknown vote type {vote.type}")

@@ -202,8 +202,18 @@ class BlockExecutor:
             else []
         )
         ev_size = sum(len(ev.wrapped()) for ev in evidence)
+        local_last_commit = None
+        eh = state.consensus_params.abci.vote_extensions_enable_height
+        if eh > 0 and height > eh and self.block_store is not None:
+            # deliver height-1's vote extensions to the app
+            # (reference PrepareProposalRequest.LocalLastCommit)
+            local_last_commit = self.block_store.load_extended_commit(
+                height - 1
+            )
         # evidence spends block budget before txs (reference MaxDataBytes)
-        txs = self.app.consensus.prepare_proposal(txs, max_bytes - ev_size)
+        txs = self.app.consensus.prepare_proposal(
+            txs, max_bytes - ev_size, local_last_commit
+        )
         if height == state.initial_height:
             time = block_time or state.last_block_time
         else:

@@ -36,6 +36,10 @@ def _key_height_hash(h: int) -> bytes:
     return b"HH:" + h.to_bytes(8, "big")
 
 
+def _key_ext_commit(h: int) -> bytes:
+    return b"EC:" + h.to_bytes(8, "big")
+
+
 _KEY_STATE = b"BS:state"
 
 
@@ -109,6 +113,17 @@ class BlockStore:
         raw = self._db.get(_key_seen_commit(height))
         return Commit.decode(raw) if raw else None
 
+    def save_extended_commit(self, ext_commit) -> None:
+        """Seen commit WITH vote extensions (reference SaveBlockWithExtendedCommit
+        :262) — kept per height while extensions are enabled."""
+        self._db.set(_key_ext_commit(ext_commit.height), ext_commit.encode())
+
+    def load_extended_commit(self, height: int):
+        from ..types.extended_commit import ExtendedCommit
+
+        raw = self._db.get(_key_ext_commit(height))
+        return ExtendedCommit.decode(raw) if raw else None
+
     def delete_latest_block(self) -> None:
         """Remove the top block (rollback support; reference
         internal/store/store.go DeleteLatestBlock)."""
@@ -144,7 +159,8 @@ class BlockStore:
                 if bh:
                     deletes.append(_key_block_hash(bh))
                 deletes += [_key_block(h), _key_commit(h),
-                            _key_seen_commit(h), _key_height_hash(h)]
+                            _key_seen_commit(h), _key_height_hash(h),
+                            _key_ext_commit(h)]
                 pruned += 1
             self._base = retain_height
             sets: list = []

@@ -271,3 +271,33 @@ class VoteSet:
             block_id=self.maj23,
             signatures=sigs,
         )
+
+    def make_extended_commit(self):
+        """+2/3 precommit set -> ExtendedCommit carrying vote extensions
+        (reference MakeExtendedCommit)."""
+        from .extended_commit import ExtendedCommit, ExtendedCommitSig
+
+        base = self.make_commit()
+        ext_sigs = []
+        for cs, v in zip(base.signatures, self.votes):
+            ext_sigs.append(
+                ExtendedCommitSig(
+                    block_id_flag=cs.block_id_flag,
+                    validator_address=cs.validator_address,
+                    timestamp=cs.timestamp,
+                    signature=cs.signature,
+                    extension=(v.extension if v is not None
+                               and cs.block_id_flag == BlockIDFlag.COMMIT
+                               else b""),
+                    extension_signature=(
+                        v.extension_signature if v is not None
+                        and cs.block_id_flag == BlockIDFlag.COMMIT else b""
+                    ),
+                )
+            )
+        return ExtendedCommit(
+            height=base.height,
+            round=base.round,
+            block_id=base.block_id,
+            extended_signatures=ext_sigs,
+        )
