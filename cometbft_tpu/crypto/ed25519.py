@@ -28,8 +28,25 @@ PUB_KEY_SIZE = 32
 PRIV_KEY_SIZE = 64  # seed || pubkey, matching common ed25519 private encoding
 SIG_SIZE = 64
 
-# Padded batch buckets: one compiled kernel per size.
-BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+# Padded batch buckets: one compiled kernel per size. 10240 exists for
+# the 10k-validator mega-commit workload (BASELINE config #5) — padding
+# it up to 16384 would waste 38% of lanes on the hottest batch shape.
+BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
+
+# At and above this size the RLC/MSM engine (ops/msm.py) would take over
+# from the per-lane bitmap kernel (one multi-scalar multiplication
+# instead of N ladders, reference crypto/ed25519/ed25519.go:207-240).
+# Currently parked above any real batch: the MSM math costs ~2.2x fewer
+# field muls but its jnp composition pays per-op kernel-launch overhead
+# that the ladder's single fused pallas kernel does not — flipping this
+# on awaits the fused MSM accumulate kernel (ops/msm.py docstring).
+RLC_MIN = 1 << 30
+
+# Below this size the native C++ RLC verifier wins: a commit-sized batch
+# finishes in well under a TPU dispatch round trip (batch-size-aware
+# dispatch — reference types/validation.go:26-53 picks batch vs single
+# by support; we additionally pick the backend by size).
+NATIVE_MAX = 1024
 
 
 class Ed25519PubKey(PubKey):
@@ -107,10 +124,11 @@ def _bucket(n: int) -> int:
 class Ed25519BatchVerifier(BatchVerifier):
     """Batch verifier; `backend` selects tpu (default) or cpu oracle."""
 
-    def __init__(self, backend: str = "tpu"):
+    def __init__(self, backend: str = "tpu", force_perlane: bool = False):
         self._items: list[tuple[bytes, bytes, bytes]] = []
         self._precheck_fail: list[bool] = []
         self.backend = backend
+        self._force_perlane = force_perlane
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
         if not isinstance(pub_key, Ed25519PubKey):
@@ -149,15 +167,89 @@ class Ed25519BatchVerifier(BatchVerifier):
         ours overlaps host packing with device compute instead.
         """
         n = len(self._items)
-        out = self._launch_device()
+        if not self._force_perlane:
+            if n < NATIVE_MAX:
+                pending = self._native_batch()
+                if pending is not None:
+                    return pending
+            if n >= RLC_MIN:
+                pending = self._launch_rlc()
+                if pending is not None:
+                    return pending
+        bits, all_ok = self._launch_device()
         # Snapshot per-batch state: the verifier may be reused/mutated
         # after submit() without corrupting in-flight results.
         return PendingBatch(
-            out,
+            bits,
+            all_ok,
             n,
             list(self._precheck_fail),
             [self._items[i] for i in self._oversize],
             list(self._oversize),
+        )
+
+    def _native_batch(self):
+        """Synchronous C++ RLC batch for commit-sized batches; None when
+        the native engine is unavailable (caller tries device paths)."""
+        from . import native
+
+        if not native.available():
+            return None
+        live = [
+            it for it, bad in zip(self._items, self._precheck_fail) if not bad
+        ]
+        ok = bool(live) and native.batch_verify(live)
+        if ok:
+            bits = [not bad for bad in self._precheck_fail]
+            return DonePending(all(bits), bits)
+        # blame via per-signature native verification
+        bits = []
+        for (pub, msg, sig), bad in zip(self._items, self._precheck_fail):
+            bits.append(not bad and native.verify(pub, msg, sig))
+        return DonePending(all(bits), bits)
+
+    def _launch_rlc(self):
+        """RLC/MSM path: one multi-scalar multiplication for the whole
+        batch; returns None when the host layout declines (bucket slot
+        overflow — vanishingly rare) so the per-lane kernel takes over."""
+        import jax
+
+        from ..ops.msm import rlc_verify_jit
+        from . import rlc as _rlc
+
+        n = len(self._items)
+        b = _bucket(n)
+        skip = np.asarray(self._precheck_fail, bool)
+        prep = _rlc.prepare(self._items, skip, b)
+        if prep is None:
+            return None
+        a_bytes = np.zeros((b, 32), np.uint8)
+        r_bytes = np.zeros((b, 32), np.uint8)
+        live = np.zeros((b,), bool)
+        pub_arr = np.frombuffer(
+            b"".join(it[0] for it in self._items), np.uint8
+        ).reshape(n, 32)
+        sig_arr = np.frombuffer(
+            b"".join(it[2] for it in self._items), np.uint8
+        ).reshape(n, 64)
+        a_bytes[:n] = pub_arr
+        r_bytes[:n] = sig_arr[:, :32]
+        live[:n] = ~skip
+        ok = rlc_verify_jit(
+            *jax.device_put(
+                (
+                    a_bytes,
+                    r_bytes,
+                    live,
+                    prep["gather_idx"],
+                    prep["gather_neg"],
+                    prep["weights"],
+                    prep["c_digits"],
+                )
+            )
+        )
+        return PendingRLC(
+            ok, n, list(self._precheck_fail), list(self._items)
         )
 
     def _launch_device(self):
@@ -236,41 +328,103 @@ class PendingBatch:
 
     Holds a snapshot of the per-batch host state, so the originating
     verifier can be mutated or reused after submit() without corrupting
-    in-flight results."""
+    in-flight results. The happy path fetches only the device-reduced
+    all-ok scalar (pure round-trip latency); the full bitmap transfers
+    only when some lane failed."""
 
-    __slots__ = ("_dev", "_n", "_precheck_fail", "_oversize_items",
-                 "_oversize_idx")
+    __slots__ = ("_dev", "_all_ok", "_n", "_precheck_fail",
+                 "_oversize_items", "_oversize_idx")
 
-    def __init__(self, dev, n, precheck_fail, oversize_items, oversize_idx):
+    def __init__(self, dev, all_ok, n, precheck_fail, oversize_items,
+                 oversize_idx):
         self._dev = dev
+        self._all_ok = all_ok
         self._n = n
         self._precheck_fail = precheck_fail
         self._oversize_items = oversize_items
         self._oversize_idx = oversize_idx
 
-    def _finalize(self, bits: np.ndarray) -> tuple[bool, list[bool]]:
+    def _finalize(self, bits) -> tuple[bool, list[bool]]:
         out = [bool(x) and not bad for x, bad in zip(bits, self._precheck_fail)]
         for i, (pub, msg, sig) in zip(self._oversize_idx, self._oversize_items):
             out[i] = ref.verify(pub, msg, sig)  # rare >2-block messages
         return all(out), out
 
-    def result(self) -> tuple[bool, list[bool]]:
+    def _finalize_fast(self, dev_all_ok: bool) -> tuple[bool, list[bool]]:
+        """Resolve from the scalar summary alone when possible; falls back
+        to the bitmap transfer on any failure."""
+        if dev_all_ok and not any(self._precheck_fail):
+            bits = [True] * self._n
+            ok = True
+            for i, (pub, msg, sig) in zip(
+                self._oversize_idx, self._oversize_items
+            ):
+                bits[i] = ref.verify(pub, msg, sig)
+                ok = ok and bits[i]
+            return ok, bits
         return self._finalize(np.asarray(self._dev)[: self._n])
+
+    def result(self) -> tuple[bool, list[bool]]:
+        return self._finalize_fast(bool(np.asarray(self._all_ok)))
+
+
+class DonePending:
+    """Already-resolved batch (native CPU path) behind the pending API."""
+
+    __slots__ = ("_ok", "_bits", "_all_ok")
+
+    def __init__(self, ok, bits):
+        self._ok = ok
+        self._bits = bits
+        self._all_ok = np.asarray(ok)  # collect_pending stacks this
+
+    def _finalize_fast(self, _dev_all_ok) -> tuple[bool, list[bool]]:
+        return self._ok, self._bits
+
+    def result(self) -> tuple[bool, list[bool]]:
+        return self._ok, self._bits
+
+
+class PendingRLC:
+    """In-flight RLC/MSM batch: a single device bool. On success every
+    live lane verified (random-linear-combination soundness); on failure
+    the per-lane bitmap kernel re-runs to attribute blame, mirroring the
+    reference's batch->single fallback (types/validation.go:304-311)."""
+
+    __slots__ = ("_all_ok", "_n", "_precheck_fail", "_items")
+
+    def __init__(self, all_ok, n, precheck_fail, items):
+        self._all_ok = all_ok
+        self._n = n
+        self._precheck_fail = precheck_fail
+        self._items = items
+
+    def _finalize_fast(self, dev_all_ok: bool) -> tuple[bool, list[bool]]:
+        if dev_all_ok:
+            bits = [not bad for bad in self._precheck_fail]
+            return all(bits), bits
+        # batch failed: per-lane fallback attributes individual blame
+        bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
+        for pub, msg, sig in self._items:
+            bv.add(Ed25519PubKey(pub), msg, sig)
+        return bv.submit().result()
+
+    def result(self) -> tuple[bool, list[bool]]:
+        return self._finalize_fast(bool(np.asarray(self._all_ok)))
 
 
 def collect_pending(pendings: list[PendingBatch]) -> list[tuple[bool, list[bool]]]:
-    """Fetch many in-flight batches with ONE device→host transfer."""
+    """Resolve many in-flight batches with ONE tiny device→host transfer.
+
+    Stacks the per-batch all-ok scalars on device and fetches them in a
+    single round trip; only batches whose summary reports a failure pay
+    the bitmap transfer."""
     import jax.numpy as jnp
 
     if not pendings:
         return []
-    flat = np.asarray(jnp.concatenate([p._dev for p in pendings]))
-    out, off = [], 0
-    for p in pendings:
-        bucket = p._dev.shape[0]
-        out.append(p._finalize(flat[off : off + p._n]))
-        off += bucket
-    return out
+    summaries = np.asarray(jnp.stack([p._all_ok for p in pendings]))
+    return [p._finalize_fast(bool(s)) for p, s in zip(pendings, summaries)]
 
 
 def batch_verifier(backend: str = "tpu") -> Ed25519BatchVerifier:

@@ -32,7 +32,7 @@ def _build() -> bool:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         return False
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", _SO, src]
+    cmd = ["g++", "-O3", "-march=native", "-pthread", "-fPIC", "-shared", "-o", _SO, src]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         return proc.returncode == 0 and os.path.exists(_SO)
@@ -68,6 +68,11 @@ def get_lib():
         ]
         lib.ed25519_pubkey.restype = None
         lib.ed25519_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.ed25519_batch_verify.restype = ctypes.c_int
+        lib.ed25519_batch_verify.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+        ]
         _lib = lib
         return _lib
 
@@ -91,6 +96,25 @@ def sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
     out = ctypes.create_string_buffer(64)
     lib.ed25519_sign(seed, pub, msg, len(msg), out)
     return out.raw
+
+
+def batch_verify(items) -> bool:
+    """RLC batch verify of [(pub32, msg, sig64), ...] — ONE Pippenger
+    multi-scalar multiplication in C++ (the CPU fast path for
+    commit-sized batches; the TPU MSM engine takes larger ones). False
+    means "some signature failed" — the caller re-verifies singly for
+    the bitmap, mirroring the reference fallback."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ed25519 unavailable")
+    n = len(items)
+    if n == 0:
+        return False
+    pubs = b"".join(it[0] for it in items)
+    sigs = b"".join(it[2] for it in items)
+    msgs = b"".join(it[1] for it in items)
+    lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
+    return bool(lib.ed25519_batch_verify(n, pubs, msgs, lens, sigs))
 
 
 def pubkey(seed: bytes) -> bytes:

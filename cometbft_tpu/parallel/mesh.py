@@ -37,7 +37,7 @@ def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
     """
 
     def local(a, r, s, m, tb, live):
-        bits = ed25519_verify.verify_batch(a, r, s, m, tb, live)
+        bits, _ = ed25519_verify.verify_batch(a, r, s, m, tb, live)
         # all-valid = "no live lane failed"; single psum over ICI.
         bad = jnp.sum((~bits & live).astype(jnp.int32))
         total_bad = jax.lax.psum(bad, axis)

@@ -1,0 +1,184 @@
+"""Batch-size-aware backend dispatch: native C++ RLC for commit-sized
+batches, TPU MSM for mega-batches, per-lane kernel as the blame/bitmap
+fallback (reference types/validation.go:26-53 + crypto/batch dispatch;
+sizing policy is ours — the reference has one CPU backend, we have
+three engines behind one seam)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.crypto import native
+from cometbft_tpu.crypto.ed25519 import (
+    DonePending,
+    Ed25519BatchVerifier,
+    Ed25519PubKey,
+)
+
+rng = np.random.default_rng(11)
+
+
+def _signed(n, msg_len=80):
+    out = []
+    for _ in range(n):
+        seed = bytes(rng.bytes(32))
+        msg = bytes(rng.bytes(msg_len))
+        out.append((ref.pubkey_from_seed(seed), msg, ref.sign(seed, msg)))
+    return out
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain"
+)
+
+
+@needs_native
+def test_small_batch_routes_to_native():
+    items = _signed(16)
+    bv = Ed25519BatchVerifier(backend="tpu")
+    for p, m, s in items:
+        bv.add(Ed25519PubKey(p), m, s)
+    pending = bv.submit()
+    assert isinstance(pending, DonePending), "small batch must use native"
+    ok, bits = pending.result()
+    assert ok and all(bits) and len(bits) == 16
+
+
+@needs_native
+def test_native_batch_blames_individual_failures():
+    items = _signed(12)
+    bv = Ed25519BatchVerifier(backend="tpu")
+    bad = {2, 9}
+    for i, (p, m, s) in enumerate(items):
+        if i in bad:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        bv.add(Ed25519PubKey(p), m, s)
+    ok, bits = bv.submit().result()
+    assert not ok
+    assert [not b for b in bits] == [i in bad for i in range(12)]
+
+
+@needs_native
+def test_native_batch_rejects_noncanonical_s():
+    (pub, msg, sig), = _signed(1)
+    s = int.from_bytes(sig[32:], "little")
+    mal = sig[:32] + (s + ref.L).to_bytes(32, "little")
+    bv = Ed25519BatchVerifier(backend="tpu")
+    bv.add(Ed25519PubKey(pub), msg, mal)
+    for p, m, sg in _signed(3):
+        bv.add(Ed25519PubKey(p), m, sg)
+    ok, bits = bv.submit().result()
+    assert not ok and bits == [False, True, True, True]
+
+
+@needs_native
+def test_native_batch_verify_direct():
+    items = _signed(50, msg_len=200)
+    assert native.batch_verify(items)
+    p, m, s = items[7]
+    items[7] = (p, m, bytes([s[0] ^ 1]) + s[1:])
+    assert not native.batch_verify(items)
+
+
+def test_rlc_host_layout_roundtrip():
+    """The host bucket layout must place every nonzero digit exactly
+    once with the pre-negated sign (pure-numpy check, no device)."""
+    from cometbft_tpu.crypto import rlc
+
+    items = _signed(5)
+    prep = rlc.prepare(items, np.zeros(5, bool), 64)
+    assert prep is not None
+    idx = prep["gather_idx"]  # (S, WK)
+    neg = prep["gather_neg"]
+    assert idx.shape == (rlc.slot_depth(64), rlc.WK)
+    sentinel = 2 * 64
+    # each real point index appears <= total windows times
+    used = idx[idx != sentinel]
+    assert used.size > 0
+    assert ((0 <= used) & (used < sentinel)).all()
+    # R points (idx < 64) live only in z regions: lane = region*K + b
+    z_regions = {rlc.region_of_z(w) for w in range(rlc.Z_WINDOWS)}
+    lanes = np.nonzero((idx != sentinel) & (idx < 64))[1]
+    assert set(np.unique(lanes // rlc.K_BUCKETS)) <= z_regions
+    # sentinel slots carry no sign flips
+    assert not neg[idx == sentinel].any()
+
+
+def test_rlc_host_layout_skips_precheck_failures():
+    from cometbft_tpu.crypto import rlc
+
+    items = _signed(4)
+    skip = np.array([False, True, False, False])
+    prep = rlc.prepare(items, skip, 64)
+    used = prep["gather_idx"][prep["gather_idx"] != 128]
+    # lane 1's R (idx 1) and A (idx 64+1) never contribute
+    assert not np.isin(used, [1, 65]).any()
+
+
+def test_rlc_layout_msm_semantics():
+    """Exact-integer emulation of the device MSM over the host layout:
+    gather tables + weight table + c digits must reproduce
+    [c]B + sum [z_i](-R_i) + sum [m_i](-A_i) == identity for valid
+    signatures (the oracle's point arithmetic stands in for the TPU)."""
+    from cometbft_tpu.crypto import rlc
+
+    items = _signed(9, msg_len=64)
+    bucket = 64
+    prep = rlc.prepare(items, np.zeros(len(items), bool), bucket)
+    assert prep is not None
+    idx = prep["gather_idx"]      # (S, WK)
+    negf = prep["gather_neg"]
+    wt = prep["weights"]          # (W, K)
+
+    # point table: R_i at 0..n-1, A_i at bucket..bucket+n-1 — the gather
+    # digits are PRE-negated host-side, so the raw points go in as-is
+    ident = (0, 1, 1, 0)
+    table = {}
+    for i, (p, m, s) in enumerate(items):
+        table[i] = ref._to_ext(ref._decode_point(s[:32], zip215=True))
+        table[bucket + i] = ref._to_ext(ref._decode_point(p, zip215=True))
+    sentinel = 2 * bucket
+
+    # lane accumulation
+    acc = [ident] * rlc.WK
+    for s_i in range(idx.shape[0]):
+        for lane in range(rlc.WK):
+            j = idx[s_i, lane]
+            if j == sentinel:
+                continue
+            pt = table[int(j)]
+            if negf[s_i, lane]:
+                pt = ref._ext_neg(pt)
+            acc[lane] = ref._ext_add(acc[lane], pt)
+
+    # weighted region reduction + Horner over regions: region r's weight
+    # power comes from its window (region_of_m / region_of_z inverse)
+    window_of = {}
+    for w in range(rlc.N_WINDOWS):
+        window_of[rlc.region_of_m(w)] = w
+    for w in range(rlc.Z_WINDOWS):
+        window_of[rlc.region_of_z(w)] = w
+    total = ident
+    for r in range(rlc.N_REGIONS):
+        win = ident
+        for k in range(rlc.K_BUCKETS):
+            wgt = int(wt[r, k])
+            if wgt:
+                win = ref._ext_add(
+                    win, ref._ext_scalar_mul(wgt, acc[r * rlc.K_BUCKETS + k])
+                )
+        total = ref._ext_add(
+            total, ref._ext_scalar_mul(1 << (10 * window_of[r]), win)
+        )
+
+    # add [c]B: recover c from digits
+    c = 0
+    for i, d in enumerate(prep["c_digits"][:, 0]):
+        c += int(d) << (4 * i)
+    c %= ref.L
+    gx = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+    gy = 46316835694926478169428394003475163141307993866256225615783033603165251855960
+    Bpt = ref._to_ext((gx, gy))
+    total = ref._ext_add(total, ref._ext_scalar_mul(c, Bpt))
+    total = ref._ext_scalar_mul(8, total)
+    assert ref._ext_is_identity(total), "layout must satisfy the RLC equation"

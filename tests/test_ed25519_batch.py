@@ -1,4 +1,9 @@
-"""End-to-end batch verification tests: TPU kernel vs the Python oracle."""
+"""End-to-end batch verification tests: TPU kernel vs the Python oracle.
+
+force_perlane pins the pallas bitmap kernel (production dispatch would
+route these small batches to the native C++ RLC engine and large ones
+to the TPU MSM engine - covered in test_dispatch.py).
+"""
 
 import numpy as np
 
@@ -24,7 +29,7 @@ def _signed(n, msg_len=120):
 
 def test_batch_all_valid():
     items = _signed(20)
-    bv = Ed25519BatchVerifier(backend="tpu")
+    bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
     for pub, msg, sig in items:
         assert bv.add(Ed25519PubKey(pub), msg, sig)
     ok, bits = bv.verify()
@@ -33,7 +38,7 @@ def test_batch_all_valid():
 
 def test_batch_mixed_validity_bitmap():
     items = _signed(12)
-    bv = Ed25519BatchVerifier(backend="tpu")
+    bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
     bad_idx = {1, 5, 11}
     for i, (pub, msg, sig) in enumerate(items):
         if i in bad_idx:
@@ -48,7 +53,7 @@ def test_batch_noncanonical_s_rejected_up_front():
     (pub, msg, sig), = _signed(1)
     s = int.from_bytes(sig[32:], "little")
     mal = sig[:32] + (s + ref.L).to_bytes(32, "little")
-    bv = Ed25519BatchVerifier(backend="tpu")
+    bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
     assert not bv.add(Ed25519PubKey(pub), msg, mal)
     ok, bits = bv.verify()
     assert not ok and bits == [False]
@@ -121,7 +126,7 @@ def test_batch_zip215_torsion_and_noncanonical_points():
     assert want[0] and want[1] and want[2] and not want[3]
     assert want[-2] and want[-1]
 
-    bv = Ed25519BatchVerifier(backend="tpu")
+    bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
     for pub, msg_, sig in cases:
         bv.add(Ed25519PubKey(pub), msg_, sig)
     _, bits = bv.verify()
@@ -157,7 +162,7 @@ def test_pipelined_submit_and_collect():
     from cometbft_tpu.crypto.ed25519 import collect_pending
 
     items = _signed(5)
-    bv = Ed25519BatchVerifier(backend="tpu")
+    bv = Ed25519BatchVerifier(backend="tpu", force_perlane=True)
     for pub, msg, sig in items[:3]:
         bv.add(Ed25519PubKey(pub), msg, sig)
     p1 = bv.submit()
