@@ -17,7 +17,7 @@ independent implementation from the public specifications:
   ‖ PRF(n).
 
 Verified against merlin's published conformance vector in
-tests/test_sr25519.py (test_merlin_conformance_vector).
+tests/test_multicurve.py (test_merlin_conformance_vector).
 """
 
 from __future__ import annotations

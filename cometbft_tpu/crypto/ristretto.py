@@ -5,7 +5,7 @@ reusing the integer curve arithmetic from ed25519_ref. This backs the
 sr25519 signature scheme (the reference gets it from curve25519-voi).
 
 Conformance: the generator's ristretto encoding and the small-multiple
-vectors from RFC 9496 §A are asserted in tests/test_sr25519.py.
+vectors from RFC 9496 §A are asserted in tests/test_multicurve.py.
 """
 
 from __future__ import annotations

@@ -275,7 +275,17 @@ class PexReactor(Reactor):
                 continue
             self.book.mark_attempt(addr.node_id)
             try:
-                self._switch.dial_peer(addr.host, addr.port)
+                peer = self._switch.dial_peer(addr.host, addr.port)
+                # only trust the book entry once the AUTHENTICATED peer id
+                # from the handshake matches what the book claimed —
+                # otherwise any host could pollute the book under a
+                # victim's node id (reference switch.go dial id check)
+                if peer.id != addr.node_id:
+                    self.book.mark_bad(addr.node_id)
+                    self._switch.stop_peer_for_error(
+                        peer, ValueError("dialed node id mismatch")
+                    )
+                    continue
                 self.book.mark_good(addr.node_id)
                 out += 1
             except Exception as e:  # noqa: BLE001 — dial failures expected

@@ -60,6 +60,11 @@ def rollback(block_store, state_store, remove_block: bool = False):
     params_change = min(
         invalid_state.last_height_params_changed, rollback_height + 1
     )
+    # restore the params as of validating block rollback_height+1 — a
+    # params change that landed at the rolled-back height must not
+    # survive the rollback (reference internal/state/rollback.go
+    # LoadConsensusParams(rollbackHeight+1))
+    prev_params = state_store.load_consensus_params(rollback_height + 1)
 
     rolled = replace(
         invalid_state,
@@ -73,6 +78,9 @@ def rollback(block_store, state_store, remove_block: bool = False):
         last_height_params_changed=params_change,
         last_results_hash=latest_block.header.last_results_hash,
         app_hash=latest_block.header.app_hash,
+        **(
+            {"consensus_params": prev_params} if prev_params is not None else {}
+        ),
     )
     state_store.save(rolled)
     if remove_block:

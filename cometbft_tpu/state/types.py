@@ -57,6 +57,52 @@ class ConsensusParams:
         return tmhash(payload)
 
 
+def encode_params(cp: ConsensusParams) -> bytes:
+    """Proto encoding of the full ConsensusParams (reference
+    types/params.go ToProto) for per-height persistence."""
+    block = pb.f_varint(1, cp.block.max_bytes) + pb.f_varint(2, cp.block.max_gas)
+    ev = (
+        pb.f_varint(1, cp.evidence.max_age_num_blocks)
+        + pb.f_varint(2, cp.evidence.max_age_duration_ns)
+        + pb.f_varint(3, cp.evidence.max_bytes)
+    )
+    val = b"".join(pb.f_string(1, t) for t in cp.validator.pub_key_types)
+    abci = pb.f_varint(1, cp.abci.vote_extensions_enable_height)
+    return (
+        pb.f_embedded(1, block)
+        + pb.f_embedded(2, ev)
+        + pb.f_embedded(3, val)
+        + pb.f_embedded(4, abci)
+    )
+
+
+def decode_params(buf: bytes) -> ConsensusParams:
+    d = pb.fields_to_dict(buf)
+    bd = pb.fields_to_dict(bytes(d.get(1, b"")))
+    ed = pb.fields_to_dict(bytes(d.get(2, b"")))
+    key_types = tuple(
+        bytes(v).decode()
+        for f, _, v in pb.parse_fields(bytes(d.get(3, b"")))
+        if f == 1
+    )
+    ad = pb.fields_to_dict(bytes(d.get(4, b"")))
+    return ConsensusParams(
+        block=BlockParams(
+            max_bytes=pb.to_i64(bd.get(1, 0)) or BlockParams.max_bytes,
+            max_gas=pb.to_i64(bd.get(2, 0)) or -1,
+        ),
+        evidence=EvidenceParams(
+            max_age_num_blocks=pb.to_i64(ed.get(1, 0)),
+            max_age_duration_ns=pb.to_i64(ed.get(2, 0)),
+            max_bytes=pb.to_i64(ed.get(3, 0)),
+        ),
+        validator=ValidatorParams(pub_key_types=key_types or ("ed25519",)),
+        abci=ABCIParams(
+            vote_extensions_enable_height=pb.to_i64(ad.get(1, 0))
+        ),
+    )
+
+
 def _encode_validator(v: Validator) -> bytes:
     return (
         pb.f_bytes(1, v.address)
@@ -133,6 +179,7 @@ class State:
             + pb.f_bytes(10, self.last_results_hash)
             + pb.f_bytes(11, self.app_hash)
             + pb.f_varint(12, self.last_height_params_changed)
+            + pb.f_embedded(13, encode_params(self.consensus_params))
         )
         if self.validators is not None:
             out += pb.f_embedded(6, encode_validator_set(self.validators))
@@ -158,4 +205,7 @@ class State:
             last_results_hash=bytes(d.get(10, b"")),
             app_hash=bytes(d.get(11, b"")),
             last_height_params_changed=pb.to_i64(d.get(12, 1)),
+            consensus_params=(
+                decode_params(bytes(d[13])) if 13 in d else ConsensusParams()
+            ),
         )

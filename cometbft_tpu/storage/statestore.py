@@ -38,6 +38,11 @@ class StateStore:
         # internal/state/store.go Bootstrap vs save split).
         next_height = max(state.last_block_height + 1, state.initial_height)
         sets = [(_KEY_STATE, state.encode())]
+        # params used to validate block `next_height` (reference
+        # internal/state/store.go saveConsensusParamsInfo)
+        from ..state.types import encode_params
+
+        sets.append((_key_params(next_height), encode_params(state.consensus_params)))
         if state.next_validators is not None:
             sets.append(
                 (
@@ -56,6 +61,14 @@ class StateStore:
 
         raw = self._db.get(_KEY_STATE)
         return State.decode(raw) if raw else None
+
+    def load_consensus_params(self, height: int):
+        """Params as of validating block `height`, or None if unsaved
+        (reference internal/state/store.go LoadConsensusParams)."""
+        from ..state.types import decode_params
+
+        raw = self._db.get(_key_params(height))
+        return decode_params(raw) if raw else None
 
     def load_validators(self, height: int):
         from ..state.types import decode_validator_set
