@@ -22,6 +22,10 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # when set (tcp://host:port) the node LISTENS here and a remote
+    # signer process dials in; FilePV is not used (reference
+    # PrivValidatorListenAddr)
+    priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     db_backend: str = "sqlite"  # sqlite | mem
     db_dir: str = "data"

@@ -116,18 +116,29 @@ class LightClient:
         latest = self.store.latest()
         if latest is None:
             raise ErrInvalidHeader("client not initialized (no trusted block)")
+        root = latest
         if height <= latest.height:
             got = self.store.load(height)
             if got is not None:
                 return got
-            raise ErrInvalidHeader(f"height {height} below trusted, not stored")
+            # target sits between stored trusted blocks: re-root forward
+            # verification at the highest stored block below it (any
+            # trusted block is a valid verification root; reference
+            # light/client.go VerifyLightBlockAtHeight for h < latest
+            # walks from a lower trusted header)
+            below = [h for h in self.store.heights() if h < height]
+            if not below:
+                raise ErrInvalidHeader(
+                    f"height {height} below trusted, not stored"
+                )
+            root = self.store.load(max(below))
         target = self.primary.light_block(height)
         if target is None:
             raise ErrInvalidHeader(f"primary has no light block at {height}")
         if self.skipping:
-            out = self._verify_skipping(latest, target, now)
+            out = self._verify_skipping(root, target, now)
         else:
-            out = self._verify_sequential(latest, target, now)
+            out = self._verify_sequential(root, target, now)
         self._cross_check(out)
         self.store.prune(self.pruning_size)
         return out

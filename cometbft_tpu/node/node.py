@@ -71,11 +71,27 @@ class Node:
 
         # --- identity --------------------------------------------------
         self.node_key = NodeKey.load_or_generate(_p(config.base.node_key_file))
-        kf = _p(config.base.priv_validator_key_file)
-        sf = _p(config.base.priv_validator_state_file)
-        self.priv_validator = (
-            FilePV.load(kf, sf) if os.path.exists(kf) else FilePV.generate(kf, sf)
-        )
+        if config.base.priv_validator_laddr:
+            # remote signer dials in; the key never enters this process
+            # (reference node.go createAndStartPrivValidatorSocketClient)
+            from ..privval import SignerClient
+
+            laddr = config.base.priv_validator_laddr
+            hostport = laddr.removeprefix("tcp://")
+            host, sep, port = hostport.rpartition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(
+                    f"priv_validator_laddr must be [tcp://]host:port, "
+                    f"got {laddr!r}"
+                )
+            self.priv_validator = SignerClient(host or "127.0.0.1", int(port))
+        else:
+            kf = _p(config.base.priv_validator_key_file)
+            sf = _p(config.base.priv_validator_state_file)
+            self.priv_validator = (
+                FilePV.load(kf, sf) if os.path.exists(kf)
+                else FilePV.generate(kf, sf)
+            )
 
         # --- handshake / replay ---------------------------------------
         genesis_state = make_genesis_state(
@@ -151,8 +167,13 @@ class Node:
         self.consensus_reactor.set_switch(self.switch)
         self.mempool_reactor = MempoolReactor(self.mempool)
         self.mempool_reactor.set_switch(self.switch)
+        from ..evidence.reactor import EvidenceReactor
+
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+        self.evidence_reactor.set_switch(self.switch)
         self.switch.add_reactor(self.consensus_reactor)
         self.switch.add_reactor(self.mempool_reactor)
+        self.switch.add_reactor(self.evidence_reactor)
         # state-sync reactor: always serve local snapshots; the syncing
         # side (pool + Syncer) activates only when config enables it
         # (reference node/node.go:427 createStatesyncReactor)
@@ -163,7 +184,8 @@ class Node:
             and config.statesync.enable else None
         )
         self.statesync_reactor = StateSyncReactor(
-            self.app_conns.snapshot, self.statesync_pool
+            self.app_conns.snapshot, self.statesync_pool,
+            block_store=self.block_store, state_store=self.state_store,
         )
         from ..blocksync.reactor import BlockSyncReactor
 
@@ -235,19 +257,90 @@ class Node:
             self.pex_reactor.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
+        # startup hand-off chain (reference node/node.go:575-584):
+        # state sync (if enabled and fresh) -> block sync -> consensus
+        if self.statesync_pool is not None:
+            self._run_state_sync()
         # catch up over block sync before consensus when we have peers
-        # that are ahead (reference SwitchToConsensus hand-off)
+        # that are ahead (reference SwitchToConsensus hand-off); sync()
+        # itself drives the status exchange and gives up after 3 s when
+        # no peer ever reports a range, so no pre-sleep is needed
         if self.config.blocksync.enable and self.switch.peers():
-            import time as _time
+            from ..utils.log import logger as _logger
 
-            _time.sleep(0.3)  # allow status exchange on fresh conns
             try:
                 synced = self.blocksync_reactor.sync(timeout_s=30)
                 if synced.last_block_height > self.consensus.sm_state.last_block_height:
                     self.consensus.reset_to_state(synced)
-            except Exception:  # noqa: BLE001 — fall through to consensus
-                pass
+            except Exception as e:  # noqa: BLE001 — consensus can still
+                # make progress via its own catchup; surface the cause
+                _logger("node").warn(
+                    "block sync failed; continuing to consensus",
+                    err=str(e)[:120],
+                )
         self.consensus.start()
+
+    def _run_state_sync(self) -> None:
+        """Restore from a peer snapshot when enabled and the node is fresh
+        (reference node/node.go:575-584 startStateSync)."""
+        import time as _time
+
+        from ..light.client import LightClient
+        from ..statesync.reactor import P2PLightProvider
+        from ..statesync.syncer import StateSyncError, Syncer
+        from ..statesync.provider import LightStateProvider
+        from ..utils.log import logger as _logger
+
+        log = _logger("statesync")
+        cfg = self.config.statesync
+        if self.consensus.sm_state.last_block_height > 0:
+            log.info("state already exists; skipping state sync")
+            return
+        # discovery: snapshot offers arrive from peers added at switch
+        # start; wait (bounded) for the pool to fill rather than sleeping
+        # a fixed interval
+        deadline = _time.monotonic() + max(cfg.discovery_time_s, 0.1) * 5
+        while self.statesync_pool.best() is None and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        if self.statesync_pool.best() is None:
+            log.warn("no snapshots discovered; skipping state sync")
+            return
+        lc = LightClient(
+            self.genesis_doc.chain_id,
+            primary=P2PLightProvider(
+                self.statesync_reactor, self.genesis_doc.chain_id
+            ),
+            trusting_period_s=cfg.trust_period_s,
+            backend=self.config.base.crypto_backend,
+        )
+        try:
+            lc.initialize(cfg.trust_height, bytes.fromhex(cfg.trust_hash))
+            provider = LightStateProvider(
+                lc,
+                self.genesis_doc.chain_id,
+                initial_height=self.genesis_doc.initial_height,
+            )
+            syncer = Syncer(
+                self.app_conns.snapshot,
+                provider,
+                self.statesync_reactor.fetch_chunk,
+                pool=self.statesync_pool,
+                temp_dir=cfg.temp_dir or None,
+                chunk_fetchers=cfg.chunk_fetchers,
+            )
+            state, commit = syncer.sync_any()
+        except StateSyncError as e:
+            log.warn("state sync failed; falling back to block sync",
+                     err=str(e)[:120])
+            return
+        except Exception as e:  # noqa: BLE001 — e.g. bad trust anchor
+            log.warn("state sync aborted", err=str(e)[:120])
+            return
+        self.state_store.save(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.blocksync_reactor.state = state
+        self.consensus.reset_to_state(state)
+        log.info("state sync complete", height=state.last_block_height)
 
     def stop(self) -> None:
         self.consensus.stop()
@@ -255,9 +348,12 @@ class Node:
         if self.pex_reactor is not None:
             self.pex_reactor.stop()
         self.consensus_reactor.stop()
+        self.evidence_reactor.stop()
         self.switch.stop()
         self.indexer_service.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if hasattr(self.priv_validator, "close"):
+            self.priv_validator.close()  # remote signer listener

@@ -1,3 +1,5 @@
 from .file_pv import FilePV, SignStep, DoubleSignError
+from .signer import SignerClient, SignerServer
 
-__all__ = ["FilePV", "SignStep", "DoubleSignError"]
+__all__ = ["FilePV", "SignStep", "DoubleSignError", "SignerClient",
+           "SignerServer"]

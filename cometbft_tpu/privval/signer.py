@@ -1,0 +1,296 @@
+"""Remote signer: the privval sidecar-process protocol.
+
+Behavior parity: reference privval/signer_listener_endpoint.go,
+signer_dialer_endpoint.go, signer_client.go, signer_server.go and
+proto/cometbft/privval/v1 — the NODE listens on priv_validator_laddr;
+the SIGNER process dials in and serves PubKey/SignVote/SignProposal/
+Ping over varint-length-prefixed proto messages. The client side
+(SignerClient) implements the PrivValidator surface, waits bounded time
+for each response, and transparently survives signer reconnects; the
+server side (SignerServer) wraps a FilePV (keeping its last-sign-state
+double-sign protection in the signer process, where the key lives).
+
+Message oneof: pub_key_request=1, pub_key_response=2,
+sign_vote_request=3, signed_vote_response=4, sign_proposal_request=5,
+signed_proposal_response=6, ping_request=7, ping_response=8.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..encoding import proto as pb
+from ..types import Proposal, Vote
+from ..utils.log import logger
+
+_log = logger("privval")
+
+
+# ----------------------------------------------------------------------
+# framing: varint-delimited proto messages
+# ----------------------------------------------------------------------
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(pb.uvarint(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("signer connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket, max_size: int = 1 << 20) -> bytes:
+    # varint length prefix, byte at a time (lengths are tiny)
+    shift = 0
+    length = 0
+    while True:
+        b = _recv_exact(sock, 1)[0]
+        length |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("corrupt length prefix")
+    if length > max_size:
+        raise ValueError(f"oversized signer message ({length} bytes)")
+    return _recv_exact(sock, length)
+
+
+def _err_field(err: str) -> bytes:
+    return pb.f_embedded(99, pb.f_string(1, err)) if err else b""
+
+
+def _parse_err(d: dict) -> str:
+    if 99 not in d:
+        return ""
+    return bytes(pb.fields_to_dict(bytes(d[99])).get(1, b"")).decode()
+
+
+# ----------------------------------------------------------------------
+# signer server (runs beside the key, dials the node)
+# ----------------------------------------------------------------------
+class SignerServer:
+    """Wraps a FilePV and serves signing requests to a node, dialing
+    (host, port) with retry (reference SignerServer + dialer endpoint)."""
+
+    def __init__(self, pv, chain_id: str, host: str, port: int,
+                 retry_interval_s: float = 0.2):
+        self.pv = pv
+        self.chain_id = chain_id
+        self.host = host
+        self.port = port
+        self.retry_interval_s = retry_interval_s
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="signer-server"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=3.0
+                )
+            except OSError:
+                if self._stopped.wait(self.retry_interval_s):
+                    return
+                continue
+            sock.settimeout(None)
+            try:
+                self._serve(sock)
+            except (ConnectionError, OSError, ValueError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _serve(self, sock: socket.socket) -> None:
+        while not self._stopped.is_set():
+            raw = _recv_msg(sock)
+            _send_msg(sock, self._handle(raw))
+
+    def _handle(self, raw: bytes) -> bytes:
+        fields = pb.parse_fields(raw)
+        if not fields:
+            return pb.f_embedded(2, _err_field("empty request"))
+        fnum, _, v = fields[0]
+        v = bytes(v)
+        if fnum == 1:  # PubKeyRequest
+            pk = self.pv.pub_key()
+            body = pb.f_string(1, pk.type_tag()) + pb.f_bytes(2, pk.bytes())
+            return pb.f_embedded(2, body)
+        if fnum == 3:  # SignVoteRequest {1: vote, 2: chain_id, 3: skip_ext}
+            d = pb.fields_to_dict(v)
+            try:
+                vote = Vote.decode(bytes(d.get(1, b"")))
+                chain_id = bytes(d.get(2, b"")).decode() or self.chain_id
+                sign_ext = bool(pb.to_i64(d.get(3, 0)))
+                self.pv.sign_vote(chain_id, vote, sign_extension=sign_ext)
+                return pb.f_embedded(4, pb.f_embedded(1, vote.encode()))
+            except Exception as e:  # noqa: BLE001 — double-sign guard etc.
+                return pb.f_embedded(4, _err_field(str(e)[:200]))
+        if fnum == 5:  # SignProposalRequest {1: proposal, 2: chain_id}
+            d = pb.fields_to_dict(v)
+            try:
+                prop = Proposal.decode(bytes(d.get(1, b"")))
+                chain_id = bytes(d.get(2, b"")).decode() or self.chain_id
+                self.pv.sign_proposal(chain_id, prop)
+                return pb.f_embedded(6, pb.f_embedded(1, prop.encode()))
+            except Exception as e:  # noqa: BLE001
+                return pb.f_embedded(6, _err_field(str(e)[:200]))
+        if fnum == 7:  # Ping
+            return pb.f_embedded(8, b"")
+        return pb.f_embedded(2, _err_field(f"unknown request {fnum}"))
+
+
+# ----------------------------------------------------------------------
+# node side: listener + PrivValidator client
+# ----------------------------------------------------------------------
+class SignerClient:
+    """PrivValidator over a remote signer connection. The node listens on
+    (host, port); the signer dials in (reference SignerListenerEndpoint +
+    SignerClient). Requests block until a signer is connected (bounded by
+    `timeout_s`); a dropped connection is replaced by the next dial-in."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 5.0):
+        self.timeout_s = timeout_s
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(1)
+        self.addr = self._lsock.getsockname()
+        self._conn: socket.socket | None = None
+        self._conn_ready = threading.Event()
+        self._lock = threading.Lock()  # one request in flight at a time
+        self._stopped = threading.Event()
+        self._pub_key = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="signer-listener"
+        )
+        self._accept_thread.start()
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            with self._lock:
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                conn.settimeout(self.timeout_s)
+                self._conn = conn
+                self._conn_ready.set()
+            _log.info("remote signer connected")
+
+    def _request(self, payload: bytes) -> dict:
+        """Send one request; returns the response oneof dict. Retries
+        across a reconnect once."""
+        deadline = time.monotonic() + self.timeout_s * 2
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            if not self._conn_ready.wait(timeout=0.1):
+                continue
+            with self._lock:
+                conn = self._conn
+                if conn is None:
+                    self._conn_ready.clear()
+                    continue
+                try:
+                    _send_msg(conn, payload)
+                    resp = _recv_msg(conn)
+                    return pb.fields_to_dict(resp)
+                except (ConnectionError, OSError, ValueError) as e:
+                    last_err = e
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    if self._conn is conn:
+                        self._conn = None
+                        self._conn_ready.clear()
+        raise ConnectionError(
+            f"no signer response within {self.timeout_s * 2:.1f}s: {last_err}"
+        )
+
+    # -- PrivValidator surface ----------------------------------------
+    def pub_key(self):
+        if self._pub_key is None:
+            d = self._request(pb.f_embedded(1, b""))
+            body = pb.fields_to_dict(bytes(d.get(2, b"")))
+            err = _parse_err(body)
+            if err:
+                raise RuntimeError(f"signer: {err}")
+            from ..crypto.ed25519 import Ed25519PubKey
+
+            self._pub_key = Ed25519PubKey(bytes(body.get(2, b"")))
+        return self._pub_key
+
+    def address(self) -> bytes:
+        return self.pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote, sign_extension: bool = False
+                  ) -> None:
+        body = pb.f_embedded(1, vote.encode()) + pb.f_string(2, chain_id)
+        if sign_extension:
+            body += pb.f_varint(3, 1)
+        d = self._request(pb.f_embedded(3, body))
+        resp = pb.fields_to_dict(bytes(d.get(4, b"")))
+        err = _parse_err(resp)
+        if err:
+            raise RuntimeError(f"signer refused vote: {err}")
+        signed = Vote.decode(bytes(resp.get(1, b"")))
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+        vote.extension_signature = signed.extension_signature
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        body = pb.f_embedded(1, proposal.encode()) + pb.f_string(2, chain_id)
+        d = self._request(pb.f_embedded(5, body))
+        resp = pb.fields_to_dict(bytes(d.get(6, b"")))
+        err = _parse_err(resp)
+        if err:
+            raise RuntimeError(f"signer refused proposal: {err}")
+        signed = Proposal.decode(bytes(resp.get(1, b"")))
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+    def ping(self) -> bool:
+        try:
+            d = self._request(pb.f_embedded(7, b""))
+            return 8 in d
+        except ConnectionError:
+            return False

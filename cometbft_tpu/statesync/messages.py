@@ -1,8 +1,11 @@
 """State-sync wire messages (reference proto/cometbft/statesync/v1).
 
 Message oneof: snapshots_request=1, snapshots_response=2,
-chunk_request=3, chunk_response=4 — field numbers match the reference
-proto for wire parity.
+chunk_request=3, chunk_response=4, light_block_request=5,
+light_block_response=6 — field numbers match the reference proto for
+wire parity. The light-block channel lets a syncing node fetch the
+trust-anchor chain from its peers (reference
+internal/statesync/reactor.go LightBlockChannel 0x62).
 """
 
 from __future__ import annotations
@@ -13,6 +16,7 @@ from ..encoding import proto as pb
 
 SNAPSHOT_CHANNEL = 0x60
 CHUNK_CHANNEL = 0x61
+LIGHT_BLOCK_CHANNEL = 0x62
 
 
 @dataclass
@@ -103,6 +107,44 @@ class ChunkResponse:
         )
 
 
+@dataclass
+class LightBlockRequest:
+    height: int = 0
+
+    def encode(self) -> bytes:
+        return pb.f_embedded(5, pb.f_varint(1, self.height))
+
+    @classmethod
+    def from_fields(cls, d: dict) -> "LightBlockRequest":
+        return cls(height=pb.to_i64(d.get(1, 0)))
+
+
+@dataclass
+class LightBlockResponse:
+    """signed_header + validator_set, both in their canonical proto
+    encodings; empty signed_header means the peer has no such block."""
+
+    height: int = 0
+    signed_header: bytes = b""
+    validator_set: bytes = b""
+
+    def encode(self) -> bytes:
+        body = (
+            pb.f_varint(1, self.height)
+            + pb.f_bytes(2, self.signed_header)
+            + pb.f_bytes(3, self.validator_set)
+        )
+        return pb.f_embedded(6, body)
+
+    @classmethod
+    def from_fields(cls, d: dict) -> "LightBlockResponse":
+        return cls(
+            height=pb.to_i64(d.get(1, 0)),
+            signed_header=bytes(d.get(2, b"")),
+            validator_set=bytes(d.get(3, b"")),
+        )
+
+
 def decode_message(buf: bytes):
     """One statesync Message -> typed dataclass (None if unknown)."""
     d = pb.fields_to_dict(buf)
@@ -114,4 +156,8 @@ def decode_message(buf: bytes):
         return ChunkRequest.from_fields(pb.fields_to_dict(bytes(d[3])))
     if 4 in d:
         return ChunkResponse.from_fields(pb.fields_to_dict(bytes(d[4])))
+    if 5 in d:
+        return LightBlockRequest.from_fields(pb.fields_to_dict(bytes(d[5])))
+    if 6 in d:
+        return LightBlockResponse.from_fields(pb.fields_to_dict(bytes(d[6])))
     return None

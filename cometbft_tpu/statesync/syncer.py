@@ -91,16 +91,28 @@ class Syncer:
             except ErrAbort:
                 raise
             except ErrRejectFormat:
+                self._log_reject(snapshot, "format rejected")
                 self.pool.reject_format(snapshot.format)
             except ErrRejectSender:
+                self._log_reject(snapshot, "sender rejected")
                 for peer in self.pool.peers(snapshot):
                     self.pool.reject_peer(peer)
                 self.pool.reject(snapshot)
-            except (ErrRejectSnapshot, ErrChunkTimeout, StateSyncError):
+            except (ErrRejectSnapshot, ErrChunkTimeout, StateSyncError) as e:
+                self._log_reject(snapshot, str(e))
                 self.pool.reject(snapshot)
             finally:
                 chunks.close()
         raise ErrNoSnapshots(f"no snapshot restored after {max_attempts} attempts")
+
+    @staticmethod
+    def _log_reject(snapshot: Snapshot, reason: str) -> None:
+        from ..utils.log import logger
+
+        logger("statesync").warn(
+            "snapshot rejected", height=snapshot.height,
+            format=snapshot.format, reason=reason[:120],
+        )
 
     # ------------------------------------------------------------------
     def sync(self, snapshot: Snapshot, chunks: ChunkQueue):
@@ -170,7 +182,13 @@ class Syncer:
             except ErrQueueClosed:
                 return
             if index is None:
-                return
+                # All chunks are currently allocated, but the app may still
+                # requeue some via RETRY/RETRY_SNAPSHOT verdicts — keep
+                # polling until the queue closes (reference fetchChunks
+                # loops on errDone rather than exiting the goroutine).
+                if stop.wait(0.05):
+                    return
+                continue
             data = None
             try:
                 data = self.fetch_chunk(snapshot, index)
@@ -185,6 +203,13 @@ class Syncer:
 
     def _apply_chunks(self, snapshot: Snapshot, chunks: ChunkQueue) -> None:
         applied = 0
+        # Retry budget: now that fetchers keep polling for requeued
+        # chunks, an app that answers RETRY/RETRY_SNAPSHOT forever (e.g.
+        # a peer serving the same corrupted chunk on every fetch) would
+        # otherwise loop the restore indefinitely. The reference bounds
+        # this by the chunk request timeout; we bound it by total retry
+        # verdicts — generous for transient faults, finite for poison.
+        retries_left = 4 * snapshot.chunks + 16
         while applied < snapshot.chunks:
             got = chunks.next(timeout=self.chunk_timeout)
             if got is None:
@@ -198,12 +223,20 @@ class Syncer:
                 continue
             if result == ApplySnapshotChunkResult.ABORT:
                 raise ErrAbort("app aborted during chunk apply")
-            if result == ApplySnapshotChunkResult.RETRY:
-                chunks.retry(index)
-                continue
-            if result == ApplySnapshotChunkResult.RETRY_SNAPSHOT:
-                chunks.retry_all()
-                applied = 0
+            if result in (
+                ApplySnapshotChunkResult.RETRY,
+                ApplySnapshotChunkResult.RETRY_SNAPSHOT,
+            ):
+                retries_left -= 1
+                if retries_left < 0:
+                    raise ErrRejectSnapshot(
+                        "chunk retry budget exhausted during apply"
+                    )
+                if result == ApplySnapshotChunkResult.RETRY:
+                    chunks.retry(index)
+                else:
+                    chunks.retry_all()
+                    applied = 0
                 continue
             if result == ApplySnapshotChunkResult.REJECT_SNAPSHOT:
                 raise ErrRejectSnapshot("app rejected snapshot during apply")

@@ -92,6 +92,20 @@ class BlockStore:
             self._save_meta(sets)
             self._db.write_batch(sets)
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """Store a commit without its block — the state-sync bootstrap
+        (reference store.go SaveSeenCommit): after a snapshot restore the
+        node holds the light-verified commit at the restore height but no
+        block, and block sync verifies H+1 against it. Also anchors
+        base/height so blocksync resumes from the restore point."""
+        with self._lock:
+            sets = [(_key_seen_commit(height), commit.encode())]
+            if self._height == 0:
+                self._base = height
+                self._height = height
+                self._save_meta(sets)
+            self._db.write_batch(sets)
+
     def load_block(self, height: int) -> Block | None:
         raw = self._db.get(_key_block(height))
         return Block.decode(raw) if raw else None

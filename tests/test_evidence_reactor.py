@@ -1,0 +1,131 @@
+"""Evidence gossip on a live net: equivocation observed by one node must
+reach every honest node's blocks (reference internal/evidence/reactor.go
++ internal/consensus/byzantine_test.go)."""
+
+import os
+import time
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.config import Config
+from cometbft_tpu.node import Node
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.types import Timestamp, Vote
+from cometbft_tpu.types.basic import BlockID, PartSetHeader
+from cometbft_tpu.types.vote import SignedMsgType
+from cometbft_tpu.consensus.state import VoteMessage
+
+
+def _mk_node(tmp_path, name, pv_key_hex, genesis, peers=""):
+    home = os.path.join(tmp_path, name)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = name
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.p2p.persistent_peers = peers
+    cfg.consensus.timeout_propose = 0.6
+    cfg.consensus.timeout_propose_delta = 0.2
+    cfg.consensus.timeout_prevote = 0.3
+    cfg.consensus.timeout_prevote_delta = 0.1
+    cfg.consensus.timeout_precommit = 0.3
+    cfg.consensus.timeout_precommit_delta = 0.1
+    cfg.consensus.timeout_commit = 0.2
+    import json
+
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        json.dump(pv_key_hex, f)
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    return Node(cfg, app=KVStoreApp())
+
+
+def test_equivocation_gossips_and_commits(tmp_path):
+    """Forged conflicting prevotes from validator v1 are injected into
+    node 0 only; the resulting DuplicateVoteEvidence must be gossiped to
+    node 1 and committed into a block on both nodes."""
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    tmp_path = str(tmp_path)
+    pvs = [FilePV.generate(None, None) for _ in range(2)]
+    genesis = GenesisDoc(
+        chain_id="byz-chain",
+        genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    keys = [
+        {
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }
+        for pv in pvs
+    ]
+    n0 = _mk_node(tmp_path, "n0", keys[0], genesis)
+    n0.start()
+    host, port = n0.listen_addr
+    n1 = _mk_node(tmp_path, "n1", keys[1], genesis, peers=f"{host}:{port}")
+    n1.start()
+    try:
+        # let the net commit a few blocks first
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if n0.consensus.sm_state.last_block_height >= 2:
+                break
+            time.sleep(0.1)
+        assert n0.consensus.sm_state.last_block_height >= 2
+
+        # forge two conflicting prevotes by v1 for the CURRENT height —
+        # retries across heights in case the round moves under us
+        byz = pvs[1]
+        byz_idx, _ = n0.consensus.validators.get_by_address(
+            byz.pub_key().address()
+        )
+
+        def forge(height, round_, tag):
+            bid = BlockID(
+                hash=bytes([tag]) * 32,
+                part_set_header=PartSetHeader(total=1, hash=bytes([tag]) * 32),
+            )
+            v = Vote(
+                type=SignedMsgType.PREVOTE,
+                height=height,
+                round=round_,
+                block_id=bid,
+                timestamp=Timestamp.from_unix_ns(time.time_ns()),
+                validator_address=byz.pub_key().address(),
+                validator_index=byz_idx,
+            )
+            v.signature = byz._priv.sign(v.sign_bytes("byz-chain"))
+            return v
+
+        found_on = set()
+        deadline = time.monotonic() + 90
+        injected_at = 0
+        while time.monotonic() < deadline and len(found_on) < 2:
+            h = n0.consensus.height
+            r = n0.consensus.round
+            if h != injected_at:
+                injected_at = h
+                n0.consensus.send(VoteMessage(forge(h, r, 0xAA)), peer_id="byz")
+                n0.consensus.send(VoteMessage(forge(h, r, 0xBB)), peer_id="byz")
+            for i, node in enumerate((n0, n1)):
+                if i in found_on:
+                    continue
+                for hh in range(1, node.block_store.height() + 1):
+                    blk = node.block_store.load_block(hh)
+                    if blk and blk.evidence:
+                        found_on.add(i)
+                        break
+            time.sleep(0.2)
+        assert found_on == {0, 1}, (
+            f"evidence committed on nodes {found_on}, expected both"
+        )
+    finally:
+        n1.stop()
+        n0.stop()

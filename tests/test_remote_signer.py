@@ -1,0 +1,147 @@
+"""Remote signer protocol: a validator signing through a separate signer
+endpoint, surviving signer restarts, with double-sign protection living
+signer-side (reference privval/signer_listener_endpoint.go,
+signer_client.go, signer_server.go)."""
+
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.privval import FilePV, SignerClient, SignerServer
+from cometbft_tpu.types import Timestamp, Vote
+from cometbft_tpu.types.basic import BlockID, PartSetHeader
+from cometbft_tpu.types.vote import SignedMsgType
+
+CHAIN = "signer-chain"
+
+
+def _vote(h, r, tag=1):
+    return Vote(
+        type=SignedMsgType.PREVOTE,
+        height=h,
+        round=r,
+        block_id=BlockID(
+            hash=bytes([tag]) * 32,
+            part_set_header=PartSetHeader(total=1, hash=bytes([tag]) * 32),
+        ),
+        timestamp=Timestamp.from_unix_ns(time.time_ns()),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+def test_sign_through_remote_signer():
+    pv = FilePV.generate(None, None)
+    client = SignerClient(timeout_s=3.0)
+    host, port = client.addr
+    server = SignerServer(pv, CHAIN, host, port)
+    server.start()
+    try:
+        assert client.pub_key().bytes() == pv.pub_key().bytes()
+        assert client.address() == pv.address()
+
+        v = _vote(5, 0)
+        client.sign_vote(CHAIN, v)
+        assert v.signature
+        assert pv.pub_key().verify_signature(v.sign_bytes(CHAIN), v.signature)
+
+        from cometbft_tpu.types import Proposal
+
+        p = Proposal(height=6, round=0, pol_round=-1,
+                     block_id=v.block_id,
+                     timestamp=Timestamp.from_unix_ns(time.time_ns()))
+        client.sign_proposal(CHAIN, p)
+        assert p.signature
+        assert client.ping()
+    finally:
+        server.stop()
+        client.close()
+
+
+def test_double_sign_protection_is_remote():
+    """The signer's FilePV last-sign-state must reject a conflicting
+    vote at the same height/round/step across the wire."""
+    pv = FilePV.generate(None, None)
+    client = SignerClient(timeout_s=3.0)
+    host, port = client.addr
+    server = SignerServer(pv, CHAIN, host, port)
+    server.start()
+    try:
+        v1 = _vote(7, 0, tag=1)
+        client.sign_vote(CHAIN, v1)
+        v2 = _vote(7, 0, tag=2)  # different block, same HRS
+        with pytest.raises(RuntimeError, match="refused"):
+            client.sign_vote(CHAIN, v2)
+    finally:
+        server.stop()
+        client.close()
+
+
+def test_signer_restart_survival():
+    pv = FilePV.generate(None, None)
+    client = SignerClient(timeout_s=3.0)
+    host, port = client.addr
+    server = SignerServer(pv, CHAIN, host, port)
+    server.start()
+    try:
+        v = _vote(9, 0)
+        client.sign_vote(CHAIN, v)
+        assert v.signature
+        # kill the signer, restart a fresh one with the same key
+        server.stop()
+        time.sleep(0.3)
+        server = SignerServer(pv, CHAIN, host, port)
+        server.start()
+        v2 = _vote(10, 0)
+        client.sign_vote(CHAIN, v2)
+        assert v2.signature
+        assert pv.pub_key().verify_signature(
+            v2.sign_bytes(CHAIN), v2.signature
+        )
+    finally:
+        server.stop()
+        client.close()
+
+
+def test_node_with_remote_signer(tmp_path):
+    """A single-validator node whose key lives in a signer process
+    commits blocks through the socket protocol end to end."""
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    tmp_path = str(tmp_path)
+    pv = FilePV.generate(None, None)
+    genesis = GenesisDoc(
+        chain_id="rs-chain",
+        genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+        validators=[GenesisValidator(pv.pub_key().bytes(), 10, "v0")],
+    )
+    home = os.path.join(tmp_path, "n0")
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.base.priv_validator_laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.consensus.timeout_commit = 0.1
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    node = Node(cfg, app=KVStoreApp())
+    host, port = node.priv_validator.addr
+    signer = SignerServer(pv, "rs-chain", host, port)
+    signer.start()
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if node.consensus.sm_state.last_block_height >= 3:
+                break
+            time.sleep(0.1)
+        assert node.consensus.sm_state.last_block_height >= 3
+    finally:
+        node.stop()
+        signer.stop()
