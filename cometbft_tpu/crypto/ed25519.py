@@ -23,6 +23,13 @@ import numpy as np
 from . import ed25519_ref as ref
 from .keys import BatchVerifier, PrivKey, PubKey, tmhash20
 
+_L = ref.L  # ed25519 group order (host-side challenge reduction)
+
+# (sha256(pubkey column), bucket) -> device-resident (ok_a, neg_a) from
+# ops.ed25519_verify.decompress_pubkeys; see _launch_device.
+_A_CACHE: dict = {}
+_A_CACHE_SIZE = 4
+
 KEY_TYPE = "tendermint/PubKeyEd25519"
 PUB_KEY_SIZE = 32
 PRIV_KEY_SIZE = 64  # seed || pubkey, matching common ed25519 private encoding
@@ -124,11 +131,17 @@ def _bucket(n: int) -> int:
 class Ed25519BatchVerifier(BatchVerifier):
     """Batch verifier; `backend` selects tpu (default) or cpu oracle."""
 
-    def __init__(self, backend: str = "tpu", force_perlane: bool = False):
+    def __init__(
+        self,
+        backend: str = "tpu",
+        force_perlane: bool = False,
+        device_sha: bool = False,
+    ):
         self._items: list[tuple[bytes, bytes, bytes]] = []
         self._precheck_fail: list[bool] = []
         self.backend = backend
         self._force_perlane = force_perlane
+        self._device_sha = device_sha
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
         if not isinstance(pub_key, Ed25519PubKey):
@@ -253,8 +266,72 @@ class Ed25519BatchVerifier(BatchVerifier):
         )
 
     def _launch_device(self):
+        """Pack host-side, hash host-side, launch the curve kernel.
+
+        The challenge k = SHA-512(R||A||M) mod L is computed on the host
+        (hashlib, ~1 us/sig): shipping 32 bytes of scalar instead of 256
+        bytes of padded message halves the wire cost twice over, and on a
+        bandwidth-limited host->device link the transfer is what bounds
+        sustained throughput. The on-device-SHA kernel remains available
+        via device_sha=True (it is the fully-fused showcase path and the
+        differential tests cover both)."""
+        import hashlib
+
+        import jax
+
+        from ..ops.ed25519_verify import (
+            decompress_pubkeys_jit,
+            verify_batch_cached_a_jit,
+        )
+
+        if self._device_sha:
+            return self._launch_device_sha()
+
+        n = len(self._items)
+        b = _bucket(n)
+        pub_blob = b"".join(it[0] for it in self._items)
+        sig_arr = np.frombuffer(
+            b"".join(it[2] for it in self._items), np.uint8
+        ).reshape(n, 64)
+        rsk = np.zeros((b, 96), np.uint8)
+        live = np.zeros((b,), bool)
+        rsk[:n, :64] = sig_arr
+        live[:n] = True
+        self._oversize = []  # host hashing has no message-length limit
+        sha = hashlib.sha512
+        rsk[:n, 64:] = np.frombuffer(
+            b"".join(
+                (
+                    int.from_bytes(
+                        sha(sig[:32] + pub + msg).digest(), "little"
+                    )
+                    % _L
+                ).to_bytes(32, "little")
+                for pub, msg, sig in self._items
+            ),
+            np.uint8,
+        ).reshape(n, 32)
+        # Device-resident pubkey cache: replay verifies the SAME validator
+        # set every height, so A ships + decompresses once per set change
+        # (keyed by content hash — 1 ms vs 50 ms of wire + exponentiation).
+        fp = (hashlib.sha256(pub_blob).digest(), b)
+        cached = _A_CACHE.get(fp)
+        if cached is None:
+            a_bytes = np.zeros((b, 32), np.uint8)
+            a_bytes[:n] = np.frombuffer(pub_blob, np.uint8).reshape(n, 32)
+            cached = decompress_pubkeys_jit(jax.device_put(a_bytes))
+            _A_CACHE[fp] = cached
+            while len(_A_CACHE) > _A_CACHE_SIZE:
+                _A_CACHE.pop(next(iter(_A_CACHE)))
+        ok_a, neg_a = cached
+        return verify_batch_cached_a_jit(
+            ok_a, neg_a, *jax.device_put((rsk, live))
+        )
+
+    def _launch_device_sha(self):
         """Pack host-side (vectorized numpy, no per-item loops) and launch
-        the kernel; returns the un-fetched (bucket,) device bitmap."""
+        the fully-fused kernel (SHA-512 + Barrett + curve on device);
+        returns the un-fetched (bucket,) device bitmap."""
         import jax.numpy as jnp
 
         from ..ops.ed25519_verify import verify_batch_jit
