@@ -8,10 +8,20 @@ from .verifier import (
     verify_non_adjacent,
     verify_stream,
 )
-from .client import LightClient, Provider, StoreProvider
+from .client import (
+    ErrConflictingHeaders,
+    ErrNoWitnesses,
+    LightClient,
+    Provider,
+    ProviderError,
+    StoreProvider,
+)
 from .store import LightStore
 
 __all__ = [
+    "ErrConflictingHeaders",
+    "ErrNoWitnesses",
+    "ProviderError",
     "LightBlock",
     "SignedHeader",
     "ErrHeaderExpired",

@@ -64,13 +64,28 @@ class StoreProvider(Provider):
 
 
 class ErrConflictingHeaders(Exception):
-    def __init__(self, witness_idx: int, height: int):
+    """A witness backed a verifying alternative header: a real fork
+    (reference light/errors.go ErrLightClientAttack). Carries the
+    generated attack evidence."""
+
+    def __init__(self, witness_idx: int, height: int, evidence=None):
         super().__init__(
             f"witness {witness_idx} disagrees at height {height} — "
-            "possible light-client attack"
+            "light-client attack"
         )
         self.witness_idx = witness_idx
         self.height = height
+        self.evidence = evidence
+
+
+class ErrNoWitnesses(Exception):
+    pass
+
+
+class ProviderError(Exception):
+    """Base for provider fetch failures; provider_http raises its own
+    subclassable variant — anything non-verification is treated as a
+    provider fault and demotes the provider."""
 
 
 class LightClient:
@@ -121,27 +136,73 @@ class LightClient:
             got = self.store.load(height)
             if got is not None:
                 return got
+            below = [h for h in self.store.heights() if h < height]
+            if not below:
+                # target is below every trusted block: verify backwards
+                # by hash links (reference light/client.go:933
+                # backwards — signatures cannot be checked against a
+                # future set, but each header pins its parent's hash)
+                return self._verify_backwards(height, now)
             # target sits between stored trusted blocks: re-root forward
             # verification at the highest stored block below it (any
             # trusted block is a valid verification root; reference
             # light/client.go VerifyLightBlockAtHeight for h < latest
             # walks from a lower trusted header)
-            below = [h for h in self.store.heights() if h < height]
-            if not below:
-                raise ErrInvalidHeader(
-                    f"height {height} below trusted, not stored"
-                )
             root = self.store.load(max(below))
-        target = self.primary.light_block(height)
-        if target is None:
-            raise ErrInvalidHeader(f"primary has no light block at {height}")
+        target = self._fetch_primary(height)
         if self.skipping:
             out = self._verify_skipping(root, target, now)
         else:
             out = self._verify_sequential(root, target, now)
-        self._cross_check(out)
+        self._cross_check(out, now)
         self.store.prune(self.pruning_size)
         return out
+
+    # ------------------------------------------------------------------
+    def _fetch_primary(self, height: int) -> LightBlock:
+        """Fetch from the primary, replacing it with a responsive witness
+        when it faults (reference light/client.go:1046 findNewPrimary)."""
+        for _ in range(1 + len(self.witnesses)):
+            try:
+                lb = self.primary.light_block(height)
+            except Exception as e:  # noqa: BLE001 — provider fault
+                self._replace_primary(str(e))
+                continue
+            if lb is None:
+                raise ErrInvalidHeader(
+                    f"primary has no light block at {height}"
+                )
+            return lb
+        raise ErrNoWitnesses("no responsive primary or witnesses left")
+
+    def _replace_primary(self, reason: str) -> None:
+        if not self.witnesses:
+            raise ErrNoWitnesses(
+                f"primary faulted ({reason}) and no witnesses remain"
+            )
+        old = self.primary
+        self.primary = self.witnesses.pop(0)
+        # the faulted primary is NOT enlisted as a witness: a provider
+        # that lied or timed out must not keep a vote in cross-checks
+        del old
+
+    def _verify_backwards(self, height: int, now: Timestamp) -> LightBlock:
+        earliest_h = min(self.store.heights())
+        cur = self.store.load(earliest_h)
+        for h in range(earliest_h - 1, height - 1, -1):
+            nxt = self._fetch_primary(h)
+            nxt.basic_validate(self.chain_id)
+            if (
+                nxt.signed_header.header.hash()
+                != cur.signed_header.header.last_block_id.hash
+            ):
+                raise ErrInvalidHeader(
+                    f"header {h} does not hash-link into trusted header "
+                    f"{cur.height}"
+                )
+            self.store.save(nxt)
+            cur = nxt
+        return cur
 
     # ------------------------------------------------------------------
     def _verify_one(self, trusted: LightBlock, new: LightBlock, now: Timestamp
@@ -216,11 +277,96 @@ class LightClient:
         return cur
 
     # ------------------------------------------------------------------
-    def _cross_check(self, lb: LightBlock) -> None:
+    def _cross_check(self, lb: LightBlock, now: Timestamp) -> None:
+        """Compare the fresh header against every witness (reference
+        light/detector.go detectDivergence).
+
+        - witness faults (network, lying validator-set hash) demote the
+          witness on the spot;
+        - a witness that merely disagrees but cannot back its header
+          with a verifying chain from our trusted root is dropped;
+        - a witness whose alternative chain VERIFIES is proof of a
+          light-client attack: evidence is built and reported to the
+          primary and all witnesses, and ErrConflictingHeaders raised."""
         want = lb.signed_header.header.hash()
+        dead = []
         for i, w in enumerate(self.witnesses):
-            other = w.light_block(lb.height)
+            try:
+                other = w.light_block(lb.height)
+            except Exception:  # noqa: BLE001 — provider fault
+                dead.append(i)
+                continue
             if other is None:
-                continue  # witness lagging: reference retries/drops it
-            if other.signed_header.header.hash() != want:
-                raise ErrConflictingHeaders(i, lb.height)
+                continue  # witness lagging: harmless, retried next time
+            if other.signed_header.header.hash() == want:
+                continue
+            ev = self._examine_conflict(w, other, now)
+            if ev is None:
+                dead.append(i)  # witness could not back its header
+                continue
+            self._report_evidence(ev)
+            raise ErrConflictingHeaders(i, lb.height, ev)
+        for i in reversed(dead):
+            self.witnesses.pop(i)
+
+    def _examine_conflict(self, witness, other: LightBlock, now: Timestamp):
+        """Try to verify the witness's divergent header from our own
+        trusted store THROUGH THE WITNESS (reference
+        light/detector.go examineConflictingHeaderAgainstTrace). Success
+        means over 1/3 of some trusted validator set signed two chains;
+        returns LightClientAttackEvidence, or None when the witness
+        cannot substantiate its header."""
+        from ..types.evidence import LightClientAttackEvidence
+
+        below = [h for h in self.store.heights() if h < other.height]
+        if not below:
+            return None
+        common = self.store.load(max(below))
+        shadow = LightClient(
+            self.chain_id,
+            primary=witness,
+            witnesses=[],
+            trusting_period_s=self.trusting_period_s,
+            trust_level=self.trust_level,
+            max_clock_drift_s=self.max_clock_drift_s,
+            backend=self.backend,
+            skipping=self.skipping,
+        )
+        shadow.store.save(common)
+        try:
+            verified = shadow.verify_to_height(other.height, now)
+        except Exception:  # noqa: BLE001 — any failure: unsubstantiated
+            return None
+        if verified.signed_header.header.hash() != other.signed_header.header.hash():
+            return None
+        # byzantine overlap: signers of the conflicting commit that sit
+        # in the trusted common validator set (reference
+        # types/evidence.go GetByzantineValidators)
+        byz = []
+        commit = other.signed_header.commit
+        for idx, cs in enumerate(commit.signatures):
+            if cs.is_absent() or idx >= len(other.validators.validators):
+                continue
+            addr = cs.validator_address
+            i2, v = common.validators.get_by_address(addr)
+            if v is not None:
+                byz.append(addr)
+        return LightClientAttackEvidence(
+            conflicting_block=other,
+            common_height=common.height,
+            byzantine_validators=byz,
+            total_voting_power=common.validators.total_voting_power(),
+            timestamp=common.signed_header.header.time,
+        )
+
+    def _report_evidence(self, ev) -> None:
+        """Hand the attack evidence to every provider that can accept it
+        (reference light/detector.go sendEvidence)."""
+        for p in [self.primary, *self.witnesses]:
+            report = getattr(p, "report_evidence", None)
+            if report is None:
+                continue
+            try:
+                report(ev)
+            except Exception:  # noqa: BLE001 — best-effort broadcast
+                continue

@@ -1,0 +1,56 @@
+"""Light-block provider over the node RPC (reference
+light/provider/http/http.go).
+
+Fetches signed headers via /commit and validator sets via /validators,
+rebuilds the core types through rpc/codec.py, and sanity-checks that the
+reported validator set hashes to the header's validators_hash before
+handing the LightBlock to the verifier (the verifier re-checks
+everything; this just fails fast on a lying provider). Also carries
+report_evidence: the detector submits attack evidence back to providers
+through the broadcast_evidence route (reference
+light/provider/http ReportEvidence).
+"""
+
+from __future__ import annotations
+
+from ..rpc.client import HTTPClient
+from ..rpc.codec import commit_from_json, header_from_json, validator_set_from_json
+from .client import Provider, ProviderError
+from .types import LightBlock, SignedHeader
+
+
+class HTTPProvider(Provider):
+    def __init__(self, chain_id: str, base_url: str, timeout_s: float = 10.0):
+        self._chain_id = chain_id
+        self.client = HTTPClient(base_url)
+        self.base_url = base_url
+        self.timeout_s = timeout_s
+
+    def __repr__(self):
+        return f"HTTPProvider({self.base_url})"
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock | None:
+        try:
+            c = self.client.call("commit", {"height": str(height)})
+            v = self.client.call("validators", {"height": str(height)})
+        except Exception as e:  # noqa: BLE001 — network/RPC failure
+            raise ProviderError(f"{self.base_url}: {e}") from e
+        sh = c.get("signed_header") or {}
+        header = header_from_json(sh.get("header") or {})
+        commit = commit_from_json(sh.get("commit") or {})
+        if header.height == 0:
+            return None
+        vals = validator_set_from_json(v)
+        if vals.hash() != header.validators_hash:
+            raise ProviderError(
+                f"{self.base_url}: validator set does not hash to header "
+                f"validators_hash at height {height}"
+            )
+        return LightBlock(SignedHeader(header, commit), vals)
+
+    def report_evidence(self, ev) -> None:
+        # wrapped(): the tagged oneof form decode_evidence expects
+        self.client.call("broadcast_evidence", {"evidence": ev.wrapped().hex()})

@@ -220,6 +220,7 @@ class Node:
             genesis_doc=self.genesis_doc,
             app_conns=self.app_conns,
             node_info=info,
+            evidence_pool=self.evidence_pool,
         )
         self.rpc_server = None
         self.metrics_server = None

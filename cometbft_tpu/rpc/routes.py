@@ -23,7 +23,9 @@ class Env:
     def __init__(self, *, block_store=None, state_store=None, consensus=None,
                  mempool=None, switch=None, event_bus=None, tx_indexer=None,
                  block_indexer=None, genesis_doc=None, app_conns=None,
-                 node_info=None):
+                 node_info=None, evidence_pool=None, pex_reactor=None):
+        self.evidence_pool = evidence_pool
+        self.pex_reactor = pex_reactor
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -41,12 +43,27 @@ def _hx(b: bytes | None) -> str:
     return (b or b"").hex().upper()
 
 
-def _header_json(h) -> dict:
+def _block_id_json(bid) -> dict:
     return {
+        "hash": _hx(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": _hx(bid.part_set_header.hash),
+        },
+    }
+
+
+def _header_json(h) -> dict:
+    # Full fidelity: every hashed field travels (version and the part-set
+    # half of last_block_id are part of the header hash), so a client can
+    # rebuild the Header and recompute its hash (rpc/codec.py is the
+    # inverse; reference light/provider/http relies on the same property).
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
         "chain_id": h.chain_id,
         "height": str(h.height),
         "time": {"seconds": h.time.seconds, "nanos": h.time.nanos},
-        "last_block_id": {"hash": _hx(h.last_block_id.hash)},
+        "last_block_id": _block_id_json(h.last_block_id),
         "last_commit_hash": _hx(h.last_commit_hash),
         "data_hash": _hx(h.data_hash),
         "validators_hash": _hx(h.validators_hash),
@@ -63,7 +80,7 @@ def _commit_json(c) -> dict:
     return {
         "height": str(c.height),
         "round": c.round,
-        "block_id": {"hash": _hx(c.block_id.hash)},
+        "block_id": _block_id_json(c.block_id),
         "signatures": [
             {
                 "block_id_flag": int(cs.block_id_flag),
@@ -213,6 +230,7 @@ def validators(env, params):
             {
                 "address": _hx(v.address),
                 "pub_key": _hx(v.pub_key.bytes()),
+                "pub_key_type": v.pub_key.type_tag(),
                 "voting_power": str(v.voting_power),
                 "proposer_priority": str(v.proposer_priority),
             }
@@ -377,9 +395,82 @@ def block_search(env, params):
     return {"blocks": out, "total_count": str(len(out))}
 
 
+def broadcast_evidence(env, params):
+    """Submit proto-encoded (hex) evidence to the pool (reference
+    rpc/core/evidence.go BroadcastEvidence); the evidence reactor then
+    gossips it to peers."""
+    from ..types.evidence import EvidenceError, decode_evidence
+
+    raw = params.get("evidence", "")
+    try:
+        ev = decode_evidence(bytes.fromhex(raw))
+    except Exception as e:  # noqa: BLE001 — caller sent garbage
+        raise RPCError(-32602, f"invalid evidence: {e}") from e
+    if env.evidence_pool is None:
+        raise RPCError(-32603, "evidence pool unavailable")
+    try:
+        env.evidence_pool.add_evidence(ev)
+    except EvidenceError as e:
+        raise RPCError(-32603, f"evidence rejected: {e}") from e
+    return {"hash": _hx(ev.hash())}
+
+
+def genesis_chunked(env, params):
+    """Genesis split into base64 chunks for large documents (reference
+    rpc/core/net.go GenesisChunked)."""
+    import base64
+
+    chunk_size = 16 * 1024 * 1024
+    doc = env.genesis_doc.to_json().encode()
+    chunks = [
+        doc[i : i + chunk_size] for i in range(0, len(doc), chunk_size)
+    ] or [b""]
+    idx = int(params.get("chunk", 0))
+    if not 0 <= idx < len(chunks):
+        raise RPCError(-32602, f"chunk {idx} out of range [0, {len(chunks)})")
+    return {
+        "chunk": str(idx),
+        "total": str(len(chunks)),
+        "data": base64.b64encode(chunks[idx]).decode(),
+    }
+
+
+def _dial(env, params, mark_persistent):
+    if env.switch is None:
+        raise RPCError(-32603, "p2p switch unavailable")
+    peers = params.get("peers") or params.get("seeds") or []
+    dialed = []
+    for addr in peers:
+        try:
+            host, _, port = addr.rpartition("@")[-1].rpartition(":")
+            env.switch.dial_peer(host, int(port))
+            dialed.append(addr)
+        except Exception:  # noqa: BLE001 — unreachable peers are skipped
+            continue
+    return {"log": f"dialed {len(dialed)}/{len(peers)}"}
+
+
+def unsafe_dial_seeds(env, params):
+    return _dial(env, params, mark_persistent=False)
+
+
+def unsafe_dial_peers(env, params):
+    return _dial(env, params, mark_persistent=bool(params.get("persistent")))
+
+
+unsafe_dial_peers.__doc__ = unsafe_dial_seeds.__doc__ = (
+    "Unsafe operator route: dial the given host:port peers now "
+    "(reference rpc/core/net.go UnsafeDialSeeds/UnsafeDialPeers)."
+)
+
+
 ROUTES = {
     "health": health,
     "status": status,
+    "broadcast_evidence": broadcast_evidence,
+    "genesis_chunked": genesis_chunked,
+    "unsafe_dial_seeds": unsafe_dial_seeds,
+    "unsafe_dial_peers": unsafe_dial_peers,
     "abci_info": abci_info,
     "abci_query": abci_query,
     "block": block,

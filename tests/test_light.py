@@ -135,7 +135,10 @@ def test_client_sequential(chain):
     assert set(c.store.heights()) == {1, 2, 3, 4, 5, 6}
 
 
-def test_client_witness_conflict(chain):
+def test_client_drops_unsubstantiated_witness(chain):
+    """A witness that serves a tampered header it cannot back with a
+    verifying chain is DROPPED, not treated as an attack (reference
+    light/detector.go: examination failure removes the witness)."""
     p = _provider(chain)
 
     class LyingWitness(StoreProvider):
@@ -151,5 +154,39 @@ def test_client_witness_conflict(chain):
     c = LightClient(CHAIN, p, witnesses=[w], store=LightStore(),
                     trusting_period_s=PERIOD, backend="cpu")
     c.initialize(1, anchor.signed_header.header.hash())
-    with pytest.raises(ErrConflictingHeaders):
+    out = c.verify_to_height(7, NOW)
+    assert out.height == 7
+    assert c.witnesses == []  # liar demoted
+
+
+def test_client_detects_real_fork(chain):
+    """A witness backing a conflicting chain SIGNED BY THE SAME
+    VALIDATORS is a light-client attack: ErrConflictingHeaders with
+    LightClientAttackEvidence naming the double-signers (reference
+    light/detector.go + types/evidence.go GetByzantineValidators)."""
+    from cometbft_tpu.state.types import encode_validator_set
+    from cometbft_tpu.storage import MemKV, StateStore
+
+    p = _provider(chain)
+    # fork: same signers (same seed), different transactions
+    store2, state2, _genesis2, _signers2 = make_chain(
+        12, n_validators=4, chain_id=CHAIN, backend="cpu", txs_per_block=3
+    )
+    ss2 = StateStore(MemKV())
+    for h in range(1, 13):
+        ss2._db.set(
+            b"SV:" + h.to_bytes(8, "big"),
+            encode_validator_set(state2.validators),
+        )
+    w = StoreProvider(CHAIN, store2, ss2)
+    anchor = _lb(p, 1)
+    c = LightClient(CHAIN, p, witnesses=[w], store=LightStore(),
+                    trusting_period_s=PERIOD, backend="cpu")
+    c.initialize(1, anchor.signed_header.header.hash())
+    with pytest.raises(ErrConflictingHeaders) as ei:
         c.verify_to_height(7, NOW)
+    ev = ei.value.evidence
+    assert ev is not None
+    assert ev.common_height >= 1
+    assert len(ev.byzantine_validators) >= 3  # all four signed both chains
+    assert ev.conflicting_block.height == 7
