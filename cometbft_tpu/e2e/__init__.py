@@ -1,0 +1,4 @@
+from .manifest import Manifest, NodeSpec, Perturbation
+from .runner import Runner
+
+__all__ = ["Manifest", "NodeSpec", "Perturbation", "Runner"]

@@ -1,0 +1,55 @@
+"""E2E testnet manifests (reference test/e2e/pkg/manifest.go:12).
+
+A manifest declares the net (validators), the workload (tx rate), and a
+schedule of perturbations — kill -9, graceful restart, SIGSTOP pause —
+applied to named nodes at target heights. The runner executes it with
+one OS subprocess per node over real TCP and checks black-box
+invariants over RPC afterwards (reference test/e2e/runner/perturb.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeSpec:
+    name: str
+    power: int = 10
+
+
+@dataclass
+class Perturbation:
+    """At `at_height` (observed on any live node), apply `op` to `node`.
+
+    ops: kill (SIGKILL, restarted after `down_s`), restart (graceful
+    stop + start), pause (SIGSTOP for `down_s`, then SIGCONT).
+    """
+
+    node: str
+    op: str  # kill | restart | pause
+    at_height: int
+    down_s: float = 2.0
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-chain"
+    nodes: list[NodeSpec] = field(default_factory=list)
+    perturbations: list[Perturbation] = field(default_factory=list)
+    target_height: int = 12
+    tx_rate: float = 5.0  # txs/sec across the net; 0 disables load
+    timeout_s: float = 180.0
+
+    @classmethod
+    def parse(cls, d: dict) -> "Manifest":
+        return cls(
+            chain_id=d.get("chain_id", "e2e-chain"),
+            nodes=[NodeSpec(**n) for n in d.get("nodes", [])],
+            perturbations=[
+                Perturbation(**p) for p in d.get("perturbations", [])
+            ],
+            target_height=int(d.get("target_height", 12)),
+            tx_rate=float(d.get("tx_rate", 5.0)),
+            timeout_s=float(d.get("timeout_s", 180.0)),
+        )

@@ -1,0 +1,283 @@
+"""Manifest-driven e2e testnet runner (reference test/e2e/runner).
+
+One subprocess per node (`python -m cometbft_tpu.cli start`), real TCP
+p2p + RPC. The runner generates homes, tightens consensus timeouts for
+test speed, drives a tx load generator against the RPC, applies the
+manifest's perturbation schedule keyed on observed chain height
+(reference test/e2e/runner/perturb.go:31-90 — kill -9, restart,
+SIGSTOP), and finally checks black-box invariants over RPC only:
+every pair of nodes agrees on the block hash and app hash at every
+common committed height, and the chain reached the target height
+(reference test/e2e/tests/block_test.go TestBlock_Header).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from .manifest import Manifest
+
+
+class E2EError(Exception):
+    pass
+
+
+def _rpc(port: int, method: str, params: dict | None = None, timeout=3.0):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params or {}}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise E2EError(f"rpc {method}: {out['error']}")
+    return out["result"]
+
+
+class _ProcNode:
+    def __init__(self, name: str, home: str, rpc_port: int):
+        self.name = name
+        self.home = home
+        self.rpc_port = rpc_port
+        self.proc: subprocess.Popen | None = None
+        self.log = open(os.path.join(home, "node.log"), "ab")
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        # subprocess nodes run the CPU backend: many processes sharing
+        # one test machine must not all grab the accelerator
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu.cli",
+             "--home", self.home, "start"],
+            stdout=self.log, stderr=self.log, env=env,
+        )
+
+    def height(self) -> int:
+        try:
+            st = _rpc(self.rpc_port, "status")
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:  # noqa: BLE001 — down/unreachable
+            return -1
+
+    def kill9(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+            self.proc = None
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+    def pause(self) -> None:
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGSTOP)
+
+    def resume(self) -> None:
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGCONT)
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, workdir: str,
+                 starting_port: int = 0):
+        self.manifest = manifest
+        self.workdir = workdir
+        self.starting_port = starting_port or self._free_port_base(
+            2 * len(manifest.nodes)
+        )
+        self.nodes: dict[str, _ProcNode] = {}
+        self._load_stop = threading.Event()
+        self._load_thread: threading.Thread | None = None
+        self.txs_sent = 0
+
+    @staticmethod
+    def _free_port_base(count: int) -> int:
+        import socket
+
+        socks = []
+        ports = []
+        for _ in range(count):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        return min(ports) if ports else 26656
+
+    # ------------------------------------------------------------- setup
+    def setup(self) -> None:
+        from ..cli import main as cli_main
+        from ..config import Config
+
+        m = self.manifest
+        rc = cli_main([
+            "testnet", "--v", str(len(m.nodes)), "--output", self.workdir,
+            "--chain-id", m.chain_id,
+            "--starting-port", str(self.starting_port),
+        ])
+        if rc != 0:
+            raise E2EError("testnet generation failed")
+        for i, spec in enumerate(m.nodes):
+            home = os.path.join(self.workdir, f"node{i}")
+            cfg_file = os.path.join(home, "config", "config.toml")
+            cfg = Config.load(cfg_file)
+            cfg.base.db_backend = "sqlite"
+            cfg.base.crypto_backend = "cpu"
+            cfg.consensus.timeout_propose = 0.6
+            cfg.consensus.timeout_propose_delta = 0.2
+            cfg.consensus.timeout_prevote = 0.3
+            cfg.consensus.timeout_prevote_delta = 0.1
+            cfg.consensus.timeout_precommit = 0.3
+            cfg.consensus.timeout_precommit_delta = 0.1
+            cfg.consensus.timeout_commit = 0.2
+            cfg.save(cfg_file)
+            port = self.starting_port + 2 * i + 1
+            self.nodes[spec.name] = _ProcNode(spec.name, home, port)
+
+    # ------------------------------------------------------------- drive
+    def start(self) -> None:
+        for n in self.nodes.values():
+            n.start()
+        if self.manifest.tx_rate > 0:
+            self._load_thread = threading.Thread(
+                target=self._load_loop, daemon=True
+            )
+            self._load_thread.start()
+
+    def _load_loop(self) -> None:
+        """Round-robin tx load over node RPCs (reference
+        test/e2e/runner/load.go)."""
+        i = 0
+        interval = 1.0 / self.manifest.tx_rate
+        nodes = list(self.nodes.values())
+        while not self._load_stop.is_set():
+            node = nodes[i % len(nodes)]
+            tx = f"load-{i}={os.urandom(8).hex()}".encode().hex()
+            try:
+                _rpc(node.rpc_port, "broadcast_tx_async", {"tx": tx})
+                self.txs_sent += 1
+            except Exception:  # noqa: BLE001 — node may be perturbed
+                pass
+            i += 1
+            self._load_stop.wait(interval)
+
+    def max_height(self) -> int:
+        return max((n.height() for n in self.nodes.values()), default=-1)
+
+    def wait_for_height(self, h: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.max_height() >= h:
+                return
+            time.sleep(0.25)
+        raise E2EError(
+            f"testnet did not reach height {h} "
+            f"(at {self.max_height()}) within {timeout_s}s"
+        )
+
+    def run(self) -> None:
+        """Execute the manifest: start, perturb on schedule, reach the
+        target height, stop, check invariants."""
+        m = self.manifest
+        self.start()
+        try:
+            pending = sorted(m.perturbations, key=lambda p: p.at_height)
+            deadline = time.monotonic() + m.timeout_s
+            for p in pending:
+                while self.max_height() < p.at_height:
+                    if time.monotonic() > deadline:
+                        raise E2EError(
+                            f"timeout before perturbation at {p.at_height}"
+                        )
+                    time.sleep(0.25)
+                self._apply(p)
+            self.wait_for_height(
+                m.target_height, max(deadline - time.monotonic(), 1.0)
+            )
+        finally:
+            self.stop_all()
+        self.check_invariants()
+
+    def _apply(self, p) -> None:
+        node = self.nodes[p.node]
+        if p.op == "kill":
+            node.kill9()
+            time.sleep(p.down_s)
+            node.start()
+        elif p.op == "restart":
+            node.stop()
+            node.start()
+        elif p.op == "pause":
+            node.pause()
+            time.sleep(p.down_s)
+            node.resume()
+        else:
+            raise E2EError(f"unknown perturbation op {p.op!r}")
+
+    def stop_all(self) -> None:
+        self._load_stop.set()
+        if self._load_thread is not None:
+            self._load_thread.join(timeout=5)
+        for n in self.nodes.values():
+            n.stop()
+            n.log.close()
+
+    # -------------------------------------------------------- invariants
+    def check_invariants(self) -> dict:
+        """Block-hash and app-hash agreement at every common height,
+        checked from the stores the stopped nodes left behind (black-box:
+        the same data the /block RPC serves)."""
+        from ..storage import BlockStore, open_kv
+
+        chains: dict[str, dict[int, tuple[bytes, bytes]]] = {}
+        for name, n in self.nodes.items():
+            bs = BlockStore(
+                open_kv(os.path.join(n.home, "data", "blockstore.db"))
+            )
+            by_h = {}
+            for h in range(1, bs.height() + 1):
+                blk = bs.load_block(h)
+                if blk is not None:
+                    by_h[h] = (blk.hash(), bytes(blk.header.app_hash))
+            chains[name] = by_h
+        heights = [max(c) if c else 0 for c in chains.values()]
+        if not heights or max(heights) < self.manifest.target_height:
+            raise E2EError(
+                f"no node reached target {self.manifest.target_height}: "
+                f"{dict(zip(chains, heights))}"
+            )
+        names = list(chains)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                common = chains[a].keys() & chains[b].keys()
+                for h in common:
+                    if chains[a][h] != chains[b][h]:
+                        raise E2EError(
+                            f"hash divergence at height {h}: {a} vs {b}"
+                        )
+        return {
+            "heights": dict(zip(chains, heights)),
+            "txs_sent": self.txs_sent,
+        }
