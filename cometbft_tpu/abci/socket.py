@@ -117,7 +117,7 @@ class SocketServer:
                 return W.enc_check_tx_resp(app.check_tx(payload))
             if method == W.PREPARE_PROPOSAL:
                 d = pb.fields_to_dict(payload)
-                txs = W.dec_tx_list(bytes(d.get(1, b"")))
+                txs = W.dec_tx_list(pb.as_bytes(d.get(1, b"")))
                 max_bytes = pb.to_i64(d.get(2, 0))
                 return W.enc_tx_list(app.prepare_proposal(txs, max_bytes))
             if method == W.PROCESS_PROPOSAL:

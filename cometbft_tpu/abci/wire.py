@@ -53,7 +53,7 @@ def read_frame(read_exact) -> tuple[int, bytes]:
             raise ValueError("frame length varint too long")
     body = read_exact(ln)
     d = pb.fields_to_dict(body)
-    return int(d.get(1, 0)), bytes(d.get(2, b""))
+    return int(d.get(1, 0)), pb.as_bytes(d.get(2, b""))
 
 
 # ---------------------------------------------------------------- requests
@@ -62,7 +62,7 @@ def enc_tx_list(txs: list[bytes]) -> bytes:
 
 
 def dec_tx_list(buf: bytes) -> list[bytes]:
-    return [bytes(v) for f, _, v in pb.parse_fields(buf) if f == 1]
+    return [pb.as_bytes(v) for f, _, v in pb.parse_fields(buf) if f == 1]
 
 
 def enc_finalize_req(req: T.FinalizeBlockRequest) -> bytes:
@@ -101,38 +101,38 @@ def dec_finalize_req(buf: bytes) -> T.FinalizeBlockRequest:
     d = pb.fields_to_dict(buf)
     ci = T.CommitInfo()
     if 2 in d:
-        cd = pb.parse_fields(bytes(d[2]))
+        cd = pb.parse_fields(pb.as_bytes(d[2]))
         for f, _, v in cd:
             if f == 1:
                 ci.round = pb.to_i64(v)
             elif f == 2:
-                vd = pb.fields_to_dict(bytes(v))
+                vd = pb.fields_to_dict(pb.as_bytes(v))
                 ci.votes.append(
-                    (bytes(vd.get(1, b"")), pb.to_i64(vd.get(2, 0)),
+                    (pb.as_bytes(vd.get(1, b"")), pb.to_i64(vd.get(2, 0)),
                      bool(vd.get(3, 0)))
                 )
     mbs = []
     if 3 in d:
-        for f, _, v in pb.parse_fields(bytes(d[3])):
+        for f, _, v in pb.parse_fields(pb.as_bytes(d[3])):
             if f == 1:
-                md = pb.fields_to_dict(bytes(v))
+                md = pb.fields_to_dict(pb.as_bytes(v))
                 mbs.append(T.Misbehavior(
                     type=int(md.get(1, 0)),
-                    validator_address=bytes(md.get(2, b"")),
+                    validator_address=pb.as_bytes(md.get(2, b"")),
                     validator_power=pb.to_i64(md.get(3, 0)),
                     height=pb.to_i64(md.get(4, 0)),
-                    time=Timestamp.decode(bytes(md.get(5, b""))),
+                    time=Timestamp.decode(pb.as_bytes(md.get(5, b""))),
                     total_voting_power=pb.to_i64(md.get(6, 0)),
                 ))
     return T.FinalizeBlockRequest(
-        txs=dec_tx_list(bytes(d.get(1, b""))),
+        txs=dec_tx_list(pb.as_bytes(d.get(1, b""))),
         decided_last_commit=ci,
         misbehavior=mbs,
-        hash=bytes(d.get(4, b"")),
+        hash=pb.as_bytes(d.get(4, b"")),
         height=pb.to_i64(d.get(5, 0)),
-        time=Timestamp.decode(bytes(d.get(6, b""))),
-        next_validators_hash=bytes(d.get(7, b"")),
-        proposer_address=bytes(d.get(8, b"")),
+        time=Timestamp.decode(pb.as_bytes(d.get(6, b""))),
+        next_validators_hash=pb.as_bytes(d.get(7, b"")),
+        proposer_address=pb.as_bytes(d.get(8, b"")),
     )
 
 
@@ -162,23 +162,23 @@ def dec_finalize_resp(buf: bytes) -> T.FinalizeBlockResponse:
     resp = T.FinalizeBlockResponse()
     for f, _, v in pb.parse_fields(buf):
         if f == 1:
-            td = pb.fields_to_dict(bytes(v))
+            td = pb.fields_to_dict(pb.as_bytes(v))
             resp.tx_results.append(T.ExecTxResult(
                 code=int(td.get(1, 0)),
-                data=bytes(td.get(2, b"")),
-                log=bytes(td.get(3, b"")).decode("utf-8", "replace"),
+                data=pb.as_bytes(td.get(2, b"")),
+                log=pb.as_bytes(td.get(3, b"")).decode("utf-8", "replace"),
                 gas_wanted=pb.to_i64(td.get(5, 0)),
                 gas_used=pb.to_i64(td.get(6, 0)),
             ))
         elif f == 2:
-            vd = pb.fields_to_dict(bytes(v))
+            vd = pb.fields_to_dict(pb.as_bytes(v))
             resp.validator_updates.append(T.ValidatorUpdate(
-                pub_key_bytes=bytes(vd.get(1, b"")),
-                pub_key_type=bytes(vd.get(2, b"ed25519")).decode(),
+                pub_key_bytes=pb.as_bytes(vd.get(1, b"")),
+                pub_key_type=pb.as_bytes(vd.get(2, b"ed25519")).decode(),
                 power=pb.to_i64(vd.get(3, 0)),
             ))
         elif f == 3:
-            resp.app_hash = bytes(v)
+            resp.app_hash = pb.as_bytes(v)
     return resp
 
 
@@ -195,11 +195,11 @@ def enc_info_resp(r: T.InfoResponse) -> bytes:
 def dec_info_resp(buf: bytes) -> T.InfoResponse:
     d = pb.fields_to_dict(buf)
     return T.InfoResponse(
-        data=bytes(d.get(1, b"")).decode("utf-8", "replace"),
-        version=bytes(d.get(2, b"")).decode("utf-8", "replace"),
+        data=pb.as_bytes(d.get(1, b"")).decode("utf-8", "replace"),
+        version=pb.as_bytes(d.get(2, b"")).decode("utf-8", "replace"),
         app_version=pb.to_i64(d.get(3, 0)),
         last_block_height=pb.to_i64(d.get(4, 0)),
-        last_block_app_hash=bytes(d.get(5, b"")),
+        last_block_app_hash=pb.as_bytes(d.get(5, b"")),
     )
 
 
@@ -216,8 +216,8 @@ def dec_check_tx_resp(buf: bytes) -> T.CheckTxResult:
     d = pb.fields_to_dict(buf)
     return T.CheckTxResult(
         code=int(d.get(1, 0)),
-        data=bytes(d.get(2, b"")),
-        log=bytes(d.get(3, b"")).decode("utf-8", "replace"),
+        data=pb.as_bytes(d.get(2, b"")),
+        log=pb.as_bytes(d.get(3, b"")).decode("utf-8", "replace"),
         gas_wanted=pb.to_i64(d.get(4, 0)),
     )
 
@@ -229,8 +229,8 @@ def enc_query_req(path: str, data: bytes, height: int) -> bytes:
 def dec_query_req(buf: bytes) -> tuple[str, bytes, int]:
     d = pb.fields_to_dict(buf)
     return (
-        bytes(d.get(1, b"")).decode("utf-8", "replace"),
-        bytes(d.get(2, b"")),
+        pb.as_bytes(d.get(1, b"")).decode("utf-8", "replace"),
+        pb.as_bytes(d.get(2, b"")),
         pb.to_i64(d.get(3, 0)),
     )
 
@@ -249,10 +249,10 @@ def dec_query_resp(buf: bytes) -> T.QueryResponse:
     d = pb.fields_to_dict(buf)
     return T.QueryResponse(
         code=int(d.get(1, 0)),
-        key=bytes(d.get(2, b"")),
-        value=bytes(d.get(3, b"")),
+        key=pb.as_bytes(d.get(2, b"")),
+        value=pb.as_bytes(d.get(3, b"")),
         height=pb.to_i64(d.get(4, 0)),
-        log=bytes(d.get(5, b"")).decode("utf-8", "replace"),
+        log=pb.as_bytes(d.get(5, b"")).decode("utf-8", "replace"),
     )
 
 
@@ -278,19 +278,19 @@ def dec_init_chain_req(buf: bytes) -> T.InitChainRequest:
     d = pb.fields_to_dict(buf)
     vals = []
     if 3 in d:
-        for f, _, v in pb.parse_fields(bytes(d[3])):
+        for f, _, v in pb.parse_fields(pb.as_bytes(d[3])):
             if f == 1:
-                vd = pb.fields_to_dict(bytes(v))
+                vd = pb.fields_to_dict(pb.as_bytes(v))
                 vals.append(T.ValidatorUpdate(
-                    pub_key_bytes=bytes(vd.get(1, b"")),
-                    pub_key_type=bytes(vd.get(2, b"ed25519")).decode(),
+                    pub_key_bytes=pb.as_bytes(vd.get(1, b"")),
+                    pub_key_type=pb.as_bytes(vd.get(2, b"ed25519")).decode(),
                     power=pb.to_i64(vd.get(3, 0)),
                 ))
     return T.InitChainRequest(
-        time=Timestamp.decode(bytes(d.get(1, b""))),
-        chain_id=bytes(d.get(2, b"")).decode("utf-8", "replace"),
+        time=Timestamp.decode(pb.as_bytes(d.get(1, b""))),
+        chain_id=pb.as_bytes(d.get(2, b"")).decode("utf-8", "replace"),
         validators=vals,
-        app_state_bytes=bytes(d.get(4, b"")),
+        app_state_bytes=pb.as_bytes(d.get(4, b"")),
         initial_height=pb.to_i64(d.get(5, 1)),
     )
 
@@ -311,12 +311,12 @@ def dec_init_chain_resp(buf: bytes) -> T.InitChainResponse:
     d = pb.fields_to_dict(buf)
     vals = []
     if 1 in d:
-        for f, _, v in pb.parse_fields(bytes(d[1])):
+        for f, _, v in pb.parse_fields(pb.as_bytes(d[1])):
             if f == 1:
-                vd = pb.fields_to_dict(bytes(v))
+                vd = pb.fields_to_dict(pb.as_bytes(v))
                 vals.append(T.ValidatorUpdate(
-                    pub_key_bytes=bytes(vd.get(1, b"")),
-                    pub_key_type=bytes(vd.get(2, b"ed25519")).decode(),
+                    pub_key_bytes=pb.as_bytes(vd.get(1, b"")),
+                    pub_key_type=pb.as_bytes(vd.get(2, b"ed25519")).decode(),
                     power=pb.to_i64(vd.get(3, 0)),
                 ))
-    return T.InitChainResponse(validators=vals, app_hash=bytes(d.get(2, b"")))
+    return T.InitChainResponse(validators=vals, app_hash=pb.as_bytes(d.get(2, b"")))

@@ -86,7 +86,7 @@ class BlockSyncReactor(Reactor):
     def receive(self, chan_id: int, peer, raw: bytes) -> None:
         d = pb.fields_to_dict(raw)
         if 1 in d:  # BlockRequest
-            h = pb.to_i64(pb.fields_to_dict(bytes(d[1])).get(1, 0))
+            h = pb.to_i64(pb.fields_to_dict(pb.as_bytes(d[1])).get(1, 0))
             blk = self.store.load_block(h)
             if blk is None:
                 peer.send(BLOCKSYNC_CHANNEL, encode_no_block(h))
@@ -94,9 +94,9 @@ class BlockSyncReactor(Reactor):
                 peer.send(BLOCKSYNC_CHANNEL, encode_block_response(blk))
         elif 3 in d:  # BlockResponse
             if self.pool is not None:
-                inner = pb.fields_to_dict(bytes(d[3]))
+                inner = pb.fields_to_dict(pb.as_bytes(d[3]))
                 try:
-                    blk = Block.decode(bytes(inner.get(1, b"")))
+                    blk = Block.decode(pb.as_bytes(inner.get(1, b"")))
                 except Exception:  # noqa: BLE001 — malformed: drop
                     return
                 self.pool.add_block(peer.id, blk)
@@ -107,7 +107,7 @@ class BlockSyncReactor(Reactor):
             )
         elif 5 in d:  # StatusResponse
             if self.pool is not None:
-                f = pb.fields_to_dict(bytes(d[5]))
+                f = pb.fields_to_dict(pb.as_bytes(d[5]))
                 self.pool.set_peer_range(
                     peer.id, pb.to_i64(f.get(2, 0)) or 1, pb.to_i64(f.get(1, 0))
                 )

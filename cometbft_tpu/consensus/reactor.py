@@ -137,9 +137,9 @@ def _decode_proof(buf: bytes) -> merkle.Proof:
         elif f == 2:
             index = pb.to_i64(v)
         elif f == 3:
-            leaf = bytes(v)
+            leaf = pb.as_bytes(v)
         elif f == 4:
-            aunts.append(bytes(v))
+            aunts.append(pb.as_bytes(v))
     return merkle.Proof(total=total, index=index, leaf_hash=leaf, aunts=aunts)
 
 
@@ -219,7 +219,7 @@ def decode_consensus_msg(buf: bytes):
     if not fields:
         raise ValueError("empty consensus message")
     fnum, _, v = fields[0]
-    v = bytes(v)
+    v = pb.as_bytes(v)
     d = pb.fields_to_dict(v) if fnum != 1 and fnum != 2 else None
     if fnum == 1:
         return VoteMessage(Vote.decode(v))
@@ -227,7 +227,7 @@ def decode_consensus_msg(buf: bytes):
         return ProposalMessage(Proposal.decode(v))
     if fnum == 3:
         return BlockBytesMessage(
-            pb.to_i64(d.get(1, 0)), pb.to_i64(d.get(2, 0)), bytes(d.get(3, b""))
+            pb.to_i64(d.get(1, 0)), pb.to_i64(d.get(2, 0)), pb.as_bytes(d.get(3, b""))
         )
     if fnum == 4:
         return NewRoundStepMessage(
@@ -244,11 +244,11 @@ def decode_consensus_msg(buf: bytes):
             pb.to_i64(d.get(4, 0)) - 1,
         )
     if fnum == 6:
-        pd = pb.fields_to_dict(bytes(d.get(3, b"")))
+        pd = pb.fields_to_dict(pb.as_bytes(d.get(3, b"")))
         part = Part(
             index=pb.to_i64(pd.get(1, 0)) - 1,
-            bytes_=bytes(pd.get(2, b"")),
-            proof=_decode_proof(bytes(pd.get(3, b""))),
+            bytes_=pb.as_bytes(pd.get(2, b"")),
+            proof=_decode_proof(pb.as_bytes(pd.get(3, b""))),
         )
         return BlockPartMessage(
             pb.to_i64(d.get(1, 0)), pb.to_i64(d.get(2, 0)), part
@@ -258,21 +258,21 @@ def decode_consensus_msg(buf: bytes):
             pb.to_i64(d.get(1, 0)),
             pb.to_i64(d.get(2, 0)),
             SignedMsgType(pb.to_i64(d.get(3, 0))),
-            BlockID.decode(bytes(d.get(4, b""))),
+            BlockID.decode(pb.as_bytes(d.get(4, b""))),
         )
     if fnum == 8:
         return VoteSetBitsMessage(
             pb.to_i64(d.get(1, 0)),
             pb.to_i64(d.get(2, 0)),
             SignedMsgType(pb.to_i64(d.get(3, 0))),
-            BlockID.decode(bytes(d.get(4, b""))),
-            int.from_bytes(bytes(d.get(5, b"")), "little"),
+            BlockID.decode(pb.as_bytes(d.get(4, b""))),
+            int.from_bytes(pb.as_bytes(d.get(5, b"")), "little"),
         )
     if fnum == 9:
         return NewValidBlockMessage(
             pb.to_i64(d.get(1, 0)),
             pb.to_i64(d.get(2, 0)),
-            PartSetHeader.decode(bytes(d.get(3, b""))),
+            PartSetHeader.decode(pb.as_bytes(d.get(3, b""))),
             bool(pb.to_i64(d.get(4, 0))),
         )
     raise ValueError(f"unknown consensus message tag {fnum}")

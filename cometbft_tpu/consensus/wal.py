@@ -106,7 +106,7 @@ def _decode_timed(payload: bytes) -> TimedWALMessage:
         if fnum == 1:
             t = pb.to_i64(v)
         else:
-            msg = _decode_msg_field(fnum, bytes(v))
+            msg = _decode_msg_field(fnum, pb.as_bytes(v))
     if msg is None:
         raise ValueError("WAL record without message")
     return TimedWALMessage(t, msg)
@@ -117,18 +117,18 @@ def _decode_msg_field(fnum: int, v: bytes):
         return EndHeightMessage(pb.to_i64(pb.fields_to_dict(v).get(1, 0)))
     if fnum == 3:
         d = pb.fields_to_dict(v)
-        peer = bytes(d.get(15, b"")).decode()
+        peer = pb.as_bytes(d.get(15, b"")).decode()
         if 1 in d:
-            return MsgInfo(Vote.decode(bytes(d[1])), peer)
+            return MsgInfo(Vote.decode(pb.as_bytes(d[1])), peer)
         if 2 in d:
-            return MsgInfo(Proposal.decode(bytes(d[2])), peer)
+            return MsgInfo(Proposal.decode(pb.as_bytes(d[2])), peer)
         if 3 in d:
-            bd = pb.fields_to_dict(bytes(d[3]))
+            bd = pb.fields_to_dict(pb.as_bytes(d[3]))
             return MsgInfo(
                 BlockBytesMessage(
                     pb.to_i64(bd.get(1, 0)),
                     pb.to_i64(bd.get(2, 0)),
-                    bytes(bd.get(3, b"")),
+                    pb.as_bytes(bd.get(3, b"")),
                 ),
                 peer,
             )

@@ -142,3 +142,17 @@ def parse_fields(buf: bytes) -> list[tuple[int, int, object]]:
 def fields_to_dict(buf: bytes) -> dict[int, object]:
     """Last-wins dict of field -> value (repeated fields: use parse_fields)."""
     return {f: v for f, _, v in parse_fields(buf)}
+
+
+def as_bytes(v) -> bytes:
+    """Coerce a parsed field value to bytes, REJECTING type confusion.
+
+    parse_fields returns ints for varint/i64/i32 fields; calling the
+    bytes() builtin on an attacker-chosen int allocates that many zero
+    bytes (bytes(2**35) = 32 GiB) — a remote memory-exhaustion vector
+    every wire decoder would otherwise inherit. Decoders must use this
+    for every field they expect to be length-delimited.
+    """
+    if isinstance(v, int):
+        raise ValueError("expected length-delimited field, got scalar")
+    return bytes(v)

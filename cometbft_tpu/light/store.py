@@ -32,9 +32,9 @@ def _decode_vals(buf: bytes) -> ValidatorSet:
     for f, _, v in pb.parse_fields(buf):
         if f != 1:
             continue
-        d = pb.fields_to_dict(bytes(v))
+        d = pb.fields_to_dict(pb.as_bytes(v))
         val = Validator.from_pub_key(
-            decode_pub_key(pb.fields_to_dict(bytes(d.get(1, b"")))),
+            decode_pub_key(pb.fields_to_dict(pb.as_bytes(d.get(1, b"")))),
             pb.to_i64(d.get(2, 0)),
         )
         val.proposer_priority = pb.to_i64(d.get(3, 0)) - (1 << 62)
@@ -67,8 +67,8 @@ class LightStore:
             return None
         d = pb.fields_to_dict(raw)
         return LightBlock(
-            SignedHeader.decode(bytes(d.get(1, b""))),
-            _decode_vals(bytes(d.get(2, b""))),
+            SignedHeader.decode(pb.as_bytes(d.get(1, b""))),
+            _decode_vals(pb.as_bytes(d.get(2, b""))),
         )
 
     def latest(self) -> LightBlock | None:

@@ -36,8 +36,8 @@ class SignedHeader:
     def decode(cls, buf: bytes) -> "SignedHeader":
         d = pb.fields_to_dict(buf)
         return cls(
-            Header.decode(bytes(d.get(1, b""))),
-            Commit.decode(bytes(d.get(2, b""))),
+            Header.decode(pb.as_bytes(d.get(1, b""))),
+            Commit.decode(pb.as_bytes(d.get(2, b""))),
         )
 
 

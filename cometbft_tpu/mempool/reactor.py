@@ -32,7 +32,7 @@ class MempoolReactor(Reactor):
 
     def receive(self, chan_id: int, peer, msg: bytes) -> None:
         d = pb.fields_to_dict(msg)
-        tx = bytes(d.get(1, b""))
+        tx = pb.as_bytes(d.get(1, b""))
         try:
             self.mempool.check_tx(tx, from_peer=peer.id)
         except Exception:  # noqa: BLE001 — dup/full/invalid: drop

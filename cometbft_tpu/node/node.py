@@ -162,7 +162,11 @@ class Node:
             moniker=config.base.moniker,
         )
         self.transport = Transport(self.node_key, info)
-        self.switch = Switch(self.transport)
+        self.switch = Switch(
+            self.transport,
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+        )
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.set_switch(self.switch)
         self.mempool_reactor = MempoolReactor(self.mempool)

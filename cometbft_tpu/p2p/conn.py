@@ -8,9 +8,11 @@ Behavior parity: reference p2p/conn/connection.go —
 - ping/pong keepalive with a disconnect deadline (:~510);
 - an onReceive callback delivers whole reassembled messages per channel.
 
-Flow-rate limiting (reference flowrate 500 KB/s) is tracked via the
-utils.flowrate monitor; enforcement hooks are in place but default-off
-for the in-process nets.
+Flow-rate limiting is ENFORCED on both directions (reference
+connection.go:43-44 defaultSendRate/defaultRecvRate = 512000): the send
+loop stops draining channels and the recv loop stops reading frames
+once the 100 ms window budget is spent, applying backpressure through
+TCP. Pass send_rate/recv_rate=0 to disable (in-process loopback nets).
 """
 
 from __future__ import annotations
@@ -27,6 +29,38 @@ PACKET_PONG = 3
 MAX_PACKET_PAYLOAD = 1024
 PING_INTERVAL_S = 10.0
 PONG_TIMEOUT_S = 45.0
+DEFAULT_SEND_RATE = 512_000  # bytes/s (reference connection.go:43)
+DEFAULT_RECV_RATE = 512_000  # bytes/s (reference connection.go:44)
+
+
+class _RateLimiter:
+    """Windowed byte budget: spend() blocks (or reports a wait) once the
+    current 100 ms window's share of rate bytes/s is used up — the
+    flowrate.Monitor.Limit() semantics the reference applies per
+    direction."""
+
+    WINDOW_S = 0.1
+
+    def __init__(self, rate: int):
+        self.rate = rate
+        self._window_start = time.monotonic()
+        self._spent = 0
+
+    def spend(self, nbytes: int, stop_event) -> None:
+        if self.rate <= 0:
+            return
+        now = time.monotonic()
+        if now - self._window_start >= self.WINDOW_S:
+            self._window_start = now
+            self._spent = 0
+        self._spent += nbytes
+        budget = self.rate * self.WINDOW_S
+        if self._spent > budget:
+            wait = self._window_start + self.WINDOW_S - now
+            if wait > 0:
+                stop_event.wait(wait)
+            self._window_start = time.monotonic()
+            self._spent = 0
 
 
 @dataclass
@@ -73,9 +107,11 @@ class _Channel:
 
 class MConnection:
     def __init__(self, sconn, channels: list[ChannelDescriptor], on_receive,
-                 on_error=None):
+                 on_error=None, send_rate: int = DEFAULT_SEND_RATE,
+                 recv_rate: int = DEFAULT_RECV_RATE):
         """sconn: SecretConnection (or anything with write_msg/read_msg);
-        on_receive(chan_id, msg_bytes); on_error(exc)."""
+        on_receive(chan_id, msg_bytes); on_error(exc); send_rate /
+        recv_rate in bytes/s (0 disables that direction's limit)."""
         self._conn = sconn
         self._channels = {d.id: _Channel(d) for d in channels}
         self._on_receive = on_receive
@@ -84,6 +120,8 @@ class MConnection:
         self._stopped = threading.Event()
         self._last_pong = time.monotonic()
         self._threads: list[threading.Thread] = []
+        self._send_limit = _RateLimiter(send_rate)
+        self._recv_limit = _RateLimiter(recv_rate)
 
     def start(self) -> None:
         for fn in (self._send_loop, self._recv_loop, self._ping_loop):
@@ -135,6 +173,7 @@ class MConnection:
                     "<BHB", PACKET_DATA, ch.desc.id, 1 if eof else 0
                 ) + chunk
                 self._conn.write_msg(frame)
+                self._send_limit.spend(len(frame), self._stopped)
         except Exception as e:  # noqa: BLE001
             if not self._stopped.is_set():
                 self._on_error(e)
@@ -145,6 +184,7 @@ class MConnection:
                 frame = self._conn.read_msg()
                 if not frame:
                     continue
+                self._recv_limit.spend(len(frame), self._stopped)
                 kind = frame[0]
                 if kind == PACKET_PING:
                     self._conn.write_msg(struct.pack("<BHB", PACKET_PONG, 0, 0))

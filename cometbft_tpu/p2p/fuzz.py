@@ -1,0 +1,85 @@
+"""Fault-injecting connection wrapper (reference p2p/fuzz.go
+FuzzedConnection).
+
+Wraps anything with write_msg/read_msg/close and, once active, applies
+configured faults to WRITES: drop (message vanishes), delay (sleep
+before sending), corrupt (flip a random byte). Reads pass through — the
+peer's fuzzed writes already exercise our decoders. Two activation
+modes, as in the reference: "start" (clean until start_delay_s elapses,
+then always fuzz — lets handshakes complete) and "always".
+
+Determinism: faults draw from a seeded random.Random so a failing net
+test replays identically.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class FuzzConfig:
+    def __init__(
+        self,
+        mode: str = "start",  # start | always
+        start_delay_s: float = 3.0,
+        prob_drop: float = 0.1,
+        prob_delay: float = 0.1,
+        prob_corrupt: float = 0.0,
+        max_delay_s: float = 0.3,
+        seed: int = 0,
+    ):
+        self.mode = mode
+        self.start_delay_s = start_delay_s
+        self.prob_drop = prob_drop
+        self.prob_delay = prob_delay
+        self.prob_corrupt = prob_corrupt
+        self.max_delay_s = max_delay_s
+        self.seed = seed
+
+
+class FuzzedConnection:
+    def __init__(self, conn, config: FuzzConfig | None = None):
+        self._conn = conn
+        self.config = config or FuzzConfig()
+        self._rng = random.Random(self.config.seed)
+        self._born = time.monotonic()
+        self.dropped = 0
+        self.delayed = 0
+        self.corrupted = 0
+
+    def _active(self) -> bool:
+        if self.config.mode == "always":
+            return True
+        return time.monotonic() - self._born >= self.config.start_delay_s
+
+    # -- passthrough surface -------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+    def read_msg(self):
+        return self._conn.read_msg()
+
+    def close(self):
+        return self._conn.close()
+
+    def write_msg(self, data: bytes) -> None:
+        cfg = self.config
+        if self._active():
+            r = self._rng.random()
+            if r < cfg.prob_drop:
+                self.dropped += 1
+                return
+            if r < cfg.prob_drop + cfg.prob_delay:
+                self.delayed += 1
+                time.sleep(self._rng.uniform(0, cfg.max_delay_s))
+            elif r < cfg.prob_drop + cfg.prob_delay + cfg.prob_corrupt:
+                self.corrupted += 1
+                i = self._rng.randrange(len(data)) if data else 0
+                if data:
+                    data = (
+                        data[:i]
+                        + bytes([data[i] ^ (1 << self._rng.randrange(8))])
+                        + data[i + 1:]
+                    )
+        self._conn.write_msg(data)

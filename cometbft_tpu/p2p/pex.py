@@ -51,8 +51,8 @@ class NetAddress:
     @classmethod
     def from_fields(cls, d: dict) -> "NetAddress":
         return cls(
-            node_id=bytes(d.get(1, b"")).decode(),
-            host=bytes(d.get(2, b"")).decode(),
+            node_id=pb.as_bytes(d.get(1, b"")).decode(),
+            host=pb.as_bytes(d.get(2, b"")).decode(),
             port=pb.to_i64(d.get(3, 0)),
         )
 
@@ -72,9 +72,9 @@ def decode_pex_message(buf: bytes):
         return "request", None
     if 2 in d:
         addrs = []
-        for f, _, v in pb.parse_fields(bytes(d[2])):
+        for f, _, v in pb.parse_fields(pb.as_bytes(d[2])):
             if f == 1:
-                addrs.append(NetAddress.from_fields(pb.fields_to_dict(bytes(v))))
+                addrs.append(NetAddress.from_fields(pb.fields_to_dict(pb.as_bytes(v))))
         return "addrs", addrs
     return None, None
 

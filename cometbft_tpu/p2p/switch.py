@@ -51,8 +51,13 @@ class Peer:
 
 
 class Switch:
-    def __init__(self, transport: Transport):
+    def __init__(self, transport: Transport, send_rate: int | None = None,
+                 recv_rate: int | None = None):
+        from .conn import DEFAULT_RECV_RATE, DEFAULT_SEND_RATE
+
         self.transport = transport
+        self.send_rate = DEFAULT_SEND_RATE if send_rate is None else send_rate
+        self.recv_rate = DEFAULT_RECV_RATE if recv_rate is None else recv_rate
         self._reactors: list[Reactor] = []
         self._chan_owner: dict[int, Reactor] = {}
         self._descs: list[ChannelDescriptor] = []
@@ -132,7 +137,9 @@ class Switch:
         def on_error(exc) -> None:
             self.stop_peer_for_error(holder["peer"], exc)
 
-        mconn = MConnection(sconn, self._descs, on_receive, on_error)
+        mconn = MConnection(sconn, self._descs, on_receive, on_error,
+                            send_rate=self.send_rate,
+                            recv_rate=self.recv_rate)
         peer = Peer(info, mconn, outbound)
         holder["peer"] = peer
         with self._lock:

@@ -42,12 +42,12 @@ class NodeInfo:
     def decode(cls, buf: bytes) -> "NodeInfo":
         d = pb.fields_to_dict(buf)
         return cls(
-            node_id=bytes(d.get(1, b"")).decode(),
-            listen_addr=bytes(d.get(2, b"")).decode(),
-            network=bytes(d.get(3, b"")).decode(),
-            version=bytes(d.get(4, b"")).decode(),
-            channels=bytes(d.get(5, b"")),
-            moniker=bytes(d.get(6, b"")).decode(),
+            node_id=pb.as_bytes(d.get(1, b"")).decode(),
+            listen_addr=pb.as_bytes(d.get(2, b"")).decode(),
+            network=pb.as_bytes(d.get(3, b"")).decode(),
+            version=pb.as_bytes(d.get(4, b"")).decode(),
+            channels=pb.as_bytes(d.get(5, b"")),
+            moniker=pb.as_bytes(d.get(6, b"")).decode(),
         )
 
     def compatible_with(self, other: "NodeInfo") -> bool:

@@ -245,7 +245,7 @@ def _parse_ts(sign_bytes: bytes, fnum: int) -> Timestamp | None:
     if fnum not in d:
         return None
     try:
-        return Timestamp.decode(bytes(d[fnum]))
+        return Timestamp.decode(pb.as_bytes(d[fnum]))
     except Exception:
         return None
 

@@ -69,7 +69,7 @@ def _err_field(err: str) -> bytes:
 def _parse_err(d: dict) -> str:
     if 99 not in d:
         return ""
-    return bytes(pb.fields_to_dict(bytes(d[99])).get(1, b"")).decode()
+    return bytes(pb.fields_to_dict(pb.as_bytes(d[99])).get(1, b"")).decode()
 
 
 # ----------------------------------------------------------------------
@@ -129,7 +129,7 @@ class SignerServer:
         if not fields:
             return pb.f_embedded(2, _err_field("empty request"))
         fnum, _, v = fields[0]
-        v = bytes(v)
+        v = pb.as_bytes(v)
         if fnum == 1:  # PubKeyRequest
             pk = self.pv.pub_key()
             body = pb.f_string(1, pk.type_tag()) + pb.f_bytes(2, pk.bytes())
@@ -137,8 +137,8 @@ class SignerServer:
         if fnum == 3:  # SignVoteRequest {1: vote, 2: chain_id, 3: skip_ext}
             d = pb.fields_to_dict(v)
             try:
-                vote = Vote.decode(bytes(d.get(1, b"")))
-                chain_id = bytes(d.get(2, b"")).decode() or self.chain_id
+                vote = Vote.decode(pb.as_bytes(d.get(1, b"")))
+                chain_id = pb.as_bytes(d.get(2, b"")).decode() or self.chain_id
                 sign_ext = bool(pb.to_i64(d.get(3, 0)))
                 self.pv.sign_vote(chain_id, vote, sign_extension=sign_ext)
                 return pb.f_embedded(4, pb.f_embedded(1, vote.encode()))
@@ -147,8 +147,8 @@ class SignerServer:
         if fnum == 5:  # SignProposalRequest {1: proposal, 2: chain_id}
             d = pb.fields_to_dict(v)
             try:
-                prop = Proposal.decode(bytes(d.get(1, b"")))
-                chain_id = bytes(d.get(2, b"")).decode() or self.chain_id
+                prop = Proposal.decode(pb.as_bytes(d.get(1, b"")))
+                chain_id = pb.as_bytes(d.get(2, b"")).decode() or self.chain_id
                 self.pv.sign_proposal(chain_id, prop)
                 return pb.f_embedded(6, pb.f_embedded(1, prop.encode()))
             except Exception as e:  # noqa: BLE001
@@ -250,13 +250,13 @@ class SignerClient:
     def pub_key(self):
         if self._pub_key is None:
             d = self._request(pb.f_embedded(1, b""))
-            body = pb.fields_to_dict(bytes(d.get(2, b"")))
+            body = pb.fields_to_dict(pb.as_bytes(d.get(2, b"")))
             err = _parse_err(body)
             if err:
                 raise RuntimeError(f"signer: {err}")
             from ..crypto.ed25519 import Ed25519PubKey
 
-            self._pub_key = Ed25519PubKey(bytes(body.get(2, b"")))
+            self._pub_key = Ed25519PubKey(pb.as_bytes(body.get(2, b"")))
         return self._pub_key
 
     def address(self) -> bytes:
@@ -268,11 +268,11 @@ class SignerClient:
         if sign_extension:
             body += pb.f_varint(3, 1)
         d = self._request(pb.f_embedded(3, body))
-        resp = pb.fields_to_dict(bytes(d.get(4, b"")))
+        resp = pb.fields_to_dict(pb.as_bytes(d.get(4, b"")))
         err = _parse_err(resp)
         if err:
             raise RuntimeError(f"signer refused vote: {err}")
-        signed = Vote.decode(bytes(resp.get(1, b"")))
+        signed = Vote.decode(pb.as_bytes(resp.get(1, b"")))
         vote.signature = signed.signature
         vote.timestamp = signed.timestamp
         vote.extension_signature = signed.extension_signature
@@ -280,11 +280,11 @@ class SignerClient:
     def sign_proposal(self, chain_id: str, proposal) -> None:
         body = pb.f_embedded(1, proposal.encode()) + pb.f_string(2, chain_id)
         d = self._request(pb.f_embedded(5, body))
-        resp = pb.fields_to_dict(bytes(d.get(6, b"")))
+        resp = pb.fields_to_dict(pb.as_bytes(d.get(6, b"")))
         err = _parse_err(resp)
         if err:
             raise RuntimeError(f"signer refused proposal: {err}")
-        signed = Proposal.decode(bytes(resp.get(1, b"")))
+        signed = Proposal.decode(pb.as_bytes(resp.get(1, b"")))
         proposal.signature = signed.signature
         proposal.timestamp = signed.timestamp
 

@@ -78,14 +78,14 @@ def encode_params(cp: ConsensusParams) -> bytes:
 
 def decode_params(buf: bytes) -> ConsensusParams:
     d = pb.fields_to_dict(buf)
-    bd = pb.fields_to_dict(bytes(d.get(1, b"")))
-    ed = pb.fields_to_dict(bytes(d.get(2, b"")))
+    bd = pb.fields_to_dict(pb.as_bytes(d.get(1, b"")))
+    ed = pb.fields_to_dict(pb.as_bytes(d.get(2, b"")))
     key_types = tuple(
-        bytes(v).decode()
-        for f, _, v in pb.parse_fields(bytes(d.get(3, b"")))
+        pb.as_bytes(v).decode()
+        for f, _, v in pb.parse_fields(pb.as_bytes(d.get(3, b"")))
         if f == 1
     )
-    ad = pb.fields_to_dict(bytes(d.get(4, b"")))
+    ad = pb.fields_to_dict(pb.as_bytes(d.get(4, b"")))
     return ConsensusParams(
         block=BlockParams(
             max_bytes=pb.to_i64(bd.get(1, 0)) or BlockParams.max_bytes,
@@ -114,10 +114,10 @@ def _encode_validator(v: Validator) -> bytes:
 
 def _decode_validator(buf: bytes) -> Validator:
     d = pb.fields_to_dict(buf)
-    key_fields = pb.fields_to_dict(bytes(d.get(2, b"")))
+    key_fields = pb.fields_to_dict(pb.as_bytes(d.get(2, b"")))
     pk = decode_pub_key(key_fields)
     return Validator(
-        address=bytes(d.get(1, b"")),
+        address=pb.as_bytes(d.get(1, b"")),
         pub_key=pk,
         voting_power=pb.to_i64(d.get(3, 0)),
         proposer_priority=pb.to_i64(d.get(4, 0)),
@@ -138,9 +138,9 @@ def decode_validator_set(buf: bytes) -> ValidatorSet:
     prop_addr = b""
     for f, _, v in pb.parse_fields(buf):
         if f == 1:
-            vals.append(_decode_validator(bytes(v)))
+            vals.append(_decode_validator(pb.as_bytes(v)))
         elif f == 2:
-            prop_addr = bytes(v)
+            prop_addr = pb.as_bytes(v)
     vs = ValidatorSet(vals, increment_first=False)
     # restore exact priorities (ValidatorSet() copies, order by power)
     if prop_addr:
@@ -193,19 +193,19 @@ class State:
     def decode(cls, buf: bytes) -> "State":
         d = pb.fields_to_dict(buf)
         return cls(
-            chain_id=bytes(d.get(1, b"")).decode(),
+            chain_id=pb.as_bytes(d.get(1, b"")).decode(),
             initial_height=pb.to_i64(d.get(2, 1)),
             last_block_height=pb.to_i64(d.get(3, 0)),
-            last_block_id=BlockID.decode(bytes(d.get(4, b""))),
-            last_block_time=Timestamp.decode(bytes(d.get(5, b""))),
-            validators=decode_validator_set(bytes(d[6])) if 6 in d else None,
-            last_validators=decode_validator_set(bytes(d[7])) if 7 in d else None,
-            next_validators=decode_validator_set(bytes(d[9])) if 9 in d else None,
+            last_block_id=BlockID.decode(pb.as_bytes(d.get(4, b""))),
+            last_block_time=Timestamp.decode(pb.as_bytes(d.get(5, b""))),
+            validators=decode_validator_set(pb.as_bytes(d[6])) if 6 in d else None,
+            last_validators=decode_validator_set(pb.as_bytes(d[7])) if 7 in d else None,
+            next_validators=decode_validator_set(pb.as_bytes(d[9])) if 9 in d else None,
             last_height_validators_changed=pb.to_i64(d.get(8, 1)),
-            last_results_hash=bytes(d.get(10, b"")),
-            app_hash=bytes(d.get(11, b"")),
+            last_results_hash=pb.as_bytes(d.get(10, b"")),
+            app_hash=pb.as_bytes(d.get(11, b"")),
             last_height_params_changed=pb.to_i64(d.get(12, 1)),
             consensus_params=(
-                decode_params(bytes(d[13])) if 13 in d else ConsensusParams()
+                decode_params(pb.as_bytes(d[13])) if 13 in d else ConsensusParams()
             ),
         )

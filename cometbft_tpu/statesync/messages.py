@@ -49,8 +49,8 @@ class SnapshotsResponse:
             height=pb.to_i64(d.get(1, 0)),
             format=pb.to_i64(d.get(2, 0)),
             chunks=pb.to_i64(d.get(3, 0)),
-            hash=bytes(d.get(4, b"")),
-            metadata=bytes(d.get(5, b"")),
+            hash=pb.as_bytes(d.get(4, b"")),
+            metadata=pb.as_bytes(d.get(5, b"")),
         )
 
 
@@ -102,7 +102,7 @@ class ChunkResponse:
             height=pb.to_i64(d.get(1, 0)),
             format=pb.to_i64(d.get(2, 0)),
             index=pb.to_i64(d.get(3, 0)),
-            chunk=bytes(d.get(4, b"")),
+            chunk=pb.as_bytes(d.get(4, b"")),
             missing=bool(pb.to_i64(d.get(5, 0))),
         )
 
@@ -140,8 +140,8 @@ class LightBlockResponse:
     def from_fields(cls, d: dict) -> "LightBlockResponse":
         return cls(
             height=pb.to_i64(d.get(1, 0)),
-            signed_header=bytes(d.get(2, b"")),
-            validator_set=bytes(d.get(3, b"")),
+            signed_header=pb.as_bytes(d.get(2, b"")),
+            validator_set=pb.as_bytes(d.get(3, b"")),
         )
 
 
@@ -151,13 +151,13 @@ def decode_message(buf: bytes):
     if 1 in d:
         return SnapshotsRequest()
     if 2 in d:
-        return SnapshotsResponse.from_fields(pb.fields_to_dict(bytes(d[2])))
+        return SnapshotsResponse.from_fields(pb.fields_to_dict(pb.as_bytes(d[2])))
     if 3 in d:
-        return ChunkRequest.from_fields(pb.fields_to_dict(bytes(d[3])))
+        return ChunkRequest.from_fields(pb.fields_to_dict(pb.as_bytes(d[3])))
     if 4 in d:
-        return ChunkResponse.from_fields(pb.fields_to_dict(bytes(d[4])))
+        return ChunkResponse.from_fields(pb.fields_to_dict(pb.as_bytes(d[4])))
     if 5 in d:
-        return LightBlockRequest.from_fields(pb.fields_to_dict(bytes(d[5])))
+        return LightBlockRequest.from_fields(pb.fields_to_dict(pb.as_bytes(d[5])))
     if 6 in d:
-        return LightBlockResponse.from_fields(pb.fields_to_dict(bytes(d[6])))
+        return LightBlockResponse.from_fields(pb.fields_to_dict(pb.as_bytes(d[6])))
     return None

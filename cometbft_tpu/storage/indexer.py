@@ -59,10 +59,10 @@ class TxIndexer:
         return {
             "height": pb.to_i64(d.get(1, 0)),
             "index": pb.to_i64(d.get(2, 0)),
-            "tx": bytes(d.get(3, b"")),
+            "tx": pb.as_bytes(d.get(3, b"")),
             "code": int(d.get(4, 0)),
-            "data": bytes(d.get(5, b"")),
-            "events": _decode_events(bytes(d.get(6, b""))),
+            "data": pb.as_bytes(d.get(5, b"")),
+            "events": _decode_events(pb.as_bytes(d.get(6, b""))),
         }
 
     def search(self, query_str: str, limit: int = 100) -> list[dict]:
@@ -166,8 +166,8 @@ def _decode_events(buf: bytes) -> dict[str, list[str]]:
     out: dict[str, list[str]] = {}
     for f, _, v in pb.parse_fields(buf):
         if f == 1:
-            d = pb.fields_to_dict(bytes(v))
-            k = bytes(d.get(1, b"")).decode("utf-8", "replace")
-            val = bytes(d.get(2, b"")).decode("utf-8", "replace")
+            d = pb.fields_to_dict(pb.as_bytes(v))
+            k = pb.as_bytes(d.get(1, b"")).decode("utf-8", "replace")
+            val = pb.as_bytes(d.get(2, b"")).decode("utf-8", "replace")
             out.setdefault(k, []).append(val)
     return out

@@ -56,7 +56,7 @@ class PartSetHeader:
     @classmethod
     def decode(cls, buf: bytes) -> "PartSetHeader":
         d = pb.fields_to_dict(buf)
-        return cls(int(d.get(1, 0)), bytes(d.get(2, b"")))
+        return cls(int(d.get(1, 0)), pb.as_bytes(d.get(2, b"")))
 
     def is_zero(self) -> bool:
         return self.total == 0 and not self.hash
@@ -79,7 +79,7 @@ class BlockID:
     def decode(cls, buf: bytes) -> "BlockID":
         d = pb.fields_to_dict(buf)
         return cls(
-            bytes(d.get(1, b"")), PartSetHeader.decode(bytes(d.get(2, b"")))
+            pb.as_bytes(d.get(1, b"")), PartSetHeader.decode(pb.as_bytes(d.get(2, b"")))
         )
 
     def is_zero(self) -> bool:

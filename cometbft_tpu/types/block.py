@@ -121,20 +121,20 @@ class Header:
     def decode(cls, buf: bytes) -> "Header":
         d = pb.fields_to_dict(buf)
         return cls(
-            version=Consensus.decode(bytes(d.get(1, b""))),
-            chain_id=bytes(d.get(2, b"")).decode("utf-8"),
+            version=Consensus.decode(pb.as_bytes(d.get(1, b""))),
+            chain_id=pb.as_bytes(d.get(2, b"")).decode("utf-8"),
             height=pb.to_i64(d.get(3, 0)),
-            time=Timestamp.decode(bytes(d.get(4, b""))),
-            last_block_id=BlockID.decode(bytes(d.get(5, b""))),
-            last_commit_hash=bytes(d.get(6, b"")),
-            data_hash=bytes(d.get(7, b"")),
-            validators_hash=bytes(d.get(8, b"")),
-            next_validators_hash=bytes(d.get(9, b"")),
-            consensus_hash=bytes(d.get(10, b"")),
-            app_hash=bytes(d.get(11, b"")),
-            last_results_hash=bytes(d.get(12, b"")),
-            evidence_hash=bytes(d.get(13, b"")),
-            proposer_address=bytes(d.get(14, b"")),
+            time=Timestamp.decode(pb.as_bytes(d.get(4, b""))),
+            last_block_id=BlockID.decode(pb.as_bytes(d.get(5, b""))),
+            last_commit_hash=pb.as_bytes(d.get(6, b"")),
+            data_hash=pb.as_bytes(d.get(7, b"")),
+            validators_hash=pb.as_bytes(d.get(8, b"")),
+            next_validators_hash=pb.as_bytes(d.get(9, b"")),
+            consensus_hash=pb.as_bytes(d.get(10, b"")),
+            app_hash=pb.as_bytes(d.get(11, b"")),
+            last_results_hash=pb.as_bytes(d.get(12, b"")),
+            evidence_hash=pb.as_bytes(d.get(13, b"")),
+            proposer_address=pb.as_bytes(d.get(14, b"")),
         )
 
 
@@ -180,9 +180,9 @@ class CommitSig:
         d = pb.fields_to_dict(buf)
         return cls(
             block_id_flag=BlockIDFlag(int(d.get(1, 0))),
-            validator_address=bytes(d.get(2, b"")),
-            timestamp=Timestamp.decode(bytes(d.get(3, b""))),
-            signature=bytes(d.get(4, b"")),
+            validator_address=pb.as_bytes(d.get(2, b"")),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(3, b""))),
+            signature=pb.as_bytes(d.get(4, b"")),
         )
 
 
@@ -235,9 +235,9 @@ class Commit:
             elif f == 2:
                 round_ = pb.to_i64(v)
             elif f == 3:
-                block_id = BlockID.decode(bytes(v))
+                block_id = BlockID.decode(pb.as_bytes(v))
             elif f == 4:
-                sigs.append(CommitSig.decode(bytes(v)))
+                sigs.append(CommitSig.decode(pb.as_bytes(v)))
         return cls(height, round_, block_id, sigs)
 
 
@@ -269,7 +269,7 @@ class Data:
 
     @classmethod
     def decode(cls, buf: bytes) -> "Data":
-        return cls([bytes(v) for f, _, v in pb.parse_fields(buf) if f == 1])
+        return cls([pb.as_bytes(v) for f, _, v in pb.parse_fields(buf) if f == 1])
 
 
 @dataclass
@@ -298,12 +298,12 @@ class Block:
 
         d = pb.fields_to_dict(buf)
         evidence = []
-        for f, _, v in pb.parse_fields(bytes(d.get(3, b""))):
+        for f, _, v in pb.parse_fields(pb.as_bytes(d.get(3, b""))):
             if f == 1:
-                evidence.append(decode_evidence(bytes(v)))
+                evidence.append(decode_evidence(pb.as_bytes(v)))
         return cls(
-            header=Header.decode(bytes(d.get(1, b""))),
-            data=Data.decode(bytes(d.get(2, b""))),
+            header=Header.decode(pb.as_bytes(d.get(1, b""))),
+            data=Data.decode(pb.as_bytes(d.get(2, b""))),
             evidence=evidence,
-            last_commit=Commit.decode(bytes(d.get(4, b""))) if 4 in d else Commit(),
+            last_commit=Commit.decode(pb.as_bytes(d.get(4, b""))) if 4 in d else Commit(),
         )

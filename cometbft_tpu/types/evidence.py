@@ -66,11 +66,11 @@ class DuplicateVoteEvidence:
     def decode(cls, buf: bytes) -> "DuplicateVoteEvidence":
         d = pb.fields_to_dict(buf)
         return cls(
-            Vote.decode(bytes(d.get(1, b""))),
-            Vote.decode(bytes(d.get(2, b""))),
+            Vote.decode(pb.as_bytes(d.get(1, b""))),
+            Vote.decode(pb.as_bytes(d.get(2, b""))),
             pb.to_i64(d.get(3, 0)),
             pb.to_i64(d.get(4, 0)),
-            Timestamp.decode(bytes(d.get(5, b""))),
+            Timestamp.decode(pb.as_bytes(d.get(5, b""))),
         )
 
     def wrapped(self) -> bytes:
@@ -159,17 +159,17 @@ class LightClientAttackEvidence:
         byz = []
         for f, _, v in pb.parse_fields(buf):
             if f == 1:
-                sh = SignedHeader.decode(bytes(v))
+                sh = SignedHeader.decode(pb.as_bytes(v))
             elif f == 2:
-                vals = decode_validator_set(bytes(v))
+                vals = decode_validator_set(pb.as_bytes(v))
             elif f == 3:
                 common = pb.to_i64(v)
             elif f == 4:
-                byz.append(bytes(v))
+                byz.append(pb.as_bytes(v))
             elif f == 5:
                 tvp = pb.to_i64(v)
             elif f == 6:
-                ts = Timestamp.decode(bytes(v))
+                ts = Timestamp.decode(pb.as_bytes(v))
         cb = LightBlock(sh, vals) if sh is not None and vals is not None else None
         return cls(cb, common, byz, tvp, ts)
 
@@ -209,9 +209,9 @@ def decode_evidence(buf: bytes):
         raise EvidenceError("empty evidence")
     fnum, _, v = fields[0]
     if fnum == 1:
-        return DuplicateVoteEvidence.decode(bytes(v))
+        return DuplicateVoteEvidence.decode(pb.as_bytes(v))
     if fnum == 2:
-        return LightClientAttackEvidence.decode(bytes(v))
+        return LightClientAttackEvidence.decode(pb.as_bytes(v))
     raise EvidenceError(f"unknown evidence tag {fnum}")
 
 

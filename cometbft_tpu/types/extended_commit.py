@@ -39,11 +39,11 @@ class ExtendedCommitSig:
         d = pb.fields_to_dict(buf)
         return cls(
             block_id_flag=pb.to_i64(d.get(1, 0)),
-            validator_address=bytes(d.get(2, b"")),
-            timestamp=Timestamp.decode(bytes(d.get(3, b""))),
-            signature=bytes(d.get(4, b"")),
-            extension=bytes(d.get(5, b"")),
-            extension_signature=bytes(d.get(6, b"")),
+            validator_address=pb.as_bytes(d.get(2, b"")),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(3, b""))),
+            signature=pb.as_bytes(d.get(4, b"")),
+            extension=pb.as_bytes(d.get(5, b"")),
+            extension_signature=pb.as_bytes(d.get(6, b"")),
         )
 
     def to_commit_sig(self) -> CommitSig:
@@ -78,11 +78,11 @@ class ExtendedCommit:
         sigs = []
         for f, _, v in pb.parse_fields(buf):
             if f == 4:
-                sigs.append(ExtendedCommitSig.decode(bytes(v)))
+                sigs.append(ExtendedCommitSig.decode(pb.as_bytes(v)))
         return cls(
             height=pb.to_i64(d.get(1, 0)),
             round=pb.to_i64(d.get(2, 0)),
-            block_id=BlockID.decode(bytes(d.get(3, b""))),
+            block_id=BlockID.decode(pb.as_bytes(d.get(3, b""))),
             extended_signatures=sigs,
         )
 

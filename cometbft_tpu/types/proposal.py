@@ -56,7 +56,7 @@ class Proposal:
             height=pb.to_i64(d.get(2, 0)),
             round=pb.to_i64(d.get(3, 0)),
             pol_round=pb.to_i64(d.get(4, 0)),
-            block_id=BlockID.decode(bytes(d.get(5, b""))),
-            timestamp=Timestamp.decode(bytes(d.get(6, b""))),
-            signature=bytes(d.get(7, b"")),
+            block_id=BlockID.decode(pb.as_bytes(d.get(5, b""))),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(6, b""))),
+            signature=pb.as_bytes(d.get(7, b"")),
         )

@@ -126,11 +126,11 @@ class Vote:
             type=SignedMsgType(int(d.get(1, 0))),
             height=pb.to_i64(d.get(2, 0)),
             round=pb.to_i64(d.get(3, 0)),
-            block_id=BlockID.decode(bytes(d.get(4, b""))),
-            timestamp=Timestamp.decode(bytes(d.get(5, b""))),
-            validator_address=bytes(d.get(6, b"")),
+            block_id=BlockID.decode(pb.as_bytes(d.get(4, b""))),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(5, b""))),
+            validator_address=pb.as_bytes(d.get(6, b"")),
             validator_index=pb.to_i64(d.get(7, 0)),
-            signature=bytes(d.get(8, b"")),
-            extension=bytes(d.get(9, b"")),
-            extension_signature=bytes(d.get(10, b"")),
+            signature=pb.as_bytes(d.get(8, b"")),
+            extension=pb.as_bytes(d.get(9, b"")),
+            extension_signature=pb.as_bytes(d.get(10, b"")),
         )
