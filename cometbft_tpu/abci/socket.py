@@ -38,6 +38,44 @@ def _read_exact(sock: socket.socket):
     return reader
 
 
+def dispatch_abci(app: T.Application, method: int, payload: bytes) -> bytes:
+    """Decode request payload, call the app, encode the response — the
+    transport-independent ABCI server core shared by the socket and gRPC
+    servers (caller holds any app serialization lock)."""
+    from ..encoding import proto as pb
+
+    if method == W.ECHO:
+        return payload
+    if method == W.FLUSH:
+        return b""
+    if method == W.INFO:
+        return W.enc_info_resp(app.info())
+    if method == W.INIT_CHAIN:
+        return W.enc_init_chain_resp(
+            app.init_chain(W.dec_init_chain_req(payload))
+        )
+    if method == W.QUERY:
+        path, data, height = W.dec_query_req(payload)
+        return W.enc_query_resp(app.query(path, data, height))
+    if method == W.CHECK_TX:
+        return W.enc_check_tx_resp(app.check_tx(payload))
+    if method == W.PREPARE_PROPOSAL:
+        d = pb.fields_to_dict(payload)
+        txs = W.dec_tx_list(pb.as_bytes(d.get(1, b"")))
+        max_bytes = pb.to_i64(d.get(2, 0))
+        return W.enc_tx_list(app.prepare_proposal(txs, max_bytes))
+    if method == W.PROCESS_PROPOSAL:
+        txs = W.dec_tx_list(payload)
+        return pb.f_varint(1, app.process_proposal(txs), emit_zero=True)
+    if method == W.FINALIZE_BLOCK:
+        return W.enc_finalize_resp(
+            app.finalize_block(W.dec_finalize_req(payload))
+        )
+    if method == W.COMMIT:
+        return pb.f_varint(1, app.commit(), emit_zero=True)
+    raise ValueError(f"unknown ABCI method {method}")
+
+
 class SocketServer:
     """Serves one Application over unix/tcp."""
 
@@ -96,40 +134,8 @@ class SocketServer:
             conn.close()
 
     def _dispatch(self, method: int, payload: bytes) -> bytes:
-        from ..encoding import proto as pb
-
-        app = self.app
         with self._app_lock:
-            if method == W.ECHO:
-                return payload
-            if method == W.FLUSH:
-                return b""
-            if method == W.INFO:
-                return W.enc_info_resp(app.info())
-            if method == W.INIT_CHAIN:
-                return W.enc_init_chain_resp(
-                    app.init_chain(W.dec_init_chain_req(payload))
-                )
-            if method == W.QUERY:
-                path, data, height = W.dec_query_req(payload)
-                return W.enc_query_resp(app.query(path, data, height))
-            if method == W.CHECK_TX:
-                return W.enc_check_tx_resp(app.check_tx(payload))
-            if method == W.PREPARE_PROPOSAL:
-                d = pb.fields_to_dict(payload)
-                txs = W.dec_tx_list(pb.as_bytes(d.get(1, b"")))
-                max_bytes = pb.to_i64(d.get(2, 0))
-                return W.enc_tx_list(app.prepare_proposal(txs, max_bytes))
-            if method == W.PROCESS_PROPOSAL:
-                txs = W.dec_tx_list(payload)
-                return pb.f_varint(1, app.process_proposal(txs), emit_zero=True)
-            if method == W.FINALIZE_BLOCK:
-                return W.enc_finalize_resp(
-                    app.finalize_block(W.dec_finalize_req(payload))
-                )
-            if method == W.COMMIT:
-                return pb.f_varint(1, app.commit(), emit_zero=True)
-            raise ValueError(f"unknown ABCI method {method}")
+            return dispatch_abci(self.app, method, payload)
 
     def stop(self) -> None:
         self._stopped.set()

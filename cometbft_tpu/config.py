@@ -46,6 +46,11 @@ class BaseConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     max_body_bytes: int = 1_000_000
+    # gRPC services (reference [grpc] config): empty disables. The
+    # privileged listener serves the pruning/data-companion API and
+    # should stay on loopback.
+    grpc_laddr: str = ""
+    grpc_privileged_laddr: str = ""
 
     def validate(self) -> None:
         if self.max_body_bytes <= 0:

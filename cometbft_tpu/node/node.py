@@ -62,7 +62,11 @@ class Node:
         )
 
         # --- app conns -------------------------------------------------
-        if config.base.abci == "local":
+        if config.base.abci == "grpc":
+            from ..abci.grpc_transport import GrpcAppConns
+
+            self.app_conns = GrpcAppConns(config.base.proxy_app)
+        elif config.base.abci == "local":
             if app is None:
                 raise ValueError("abci=local requires an in-process app")
             self.app_conns = AppConns(app)
@@ -227,6 +231,8 @@ class Node:
             evidence_pool=self.evidence_pool,
         )
         self.rpc_server = None
+        self.grpc_server = None
+        self.grpc_privileged_server = None
         self.metrics_server = None
         if config.instrumentation.prometheus:
             from ..utils.metrics import MetricsServer
@@ -252,6 +258,27 @@ class Node:
             self.rpc_server = RPCServer(self.rpc_env, rhost, int(rport))
             self.rpc_server.start()
             self.rpc_addr = self.rpc_server.addr
+        # gRPC services (reference rpc/grpc/server: a public listener and
+        # a privileged one carrying the pruning/data-companion API)
+        if self.config.rpc.grpc_laddr:
+            from ..rpc.grpc_services import GrpcRPCServer
+
+            self.grpc_server = GrpcRPCServer(
+                self.config.rpc.grpc_laddr,
+                block_store=self.block_store,
+                state_store=self.state_store,
+            )
+            self.grpc_server.start()
+        if self.config.rpc.grpc_privileged_laddr:
+            from ..rpc.grpc_services import GrpcRPCServer
+
+            self.grpc_privileged_server = GrpcRPCServer(
+                self.config.rpc.grpc_privileged_laddr,
+                block_store=self.block_store,
+                state_store=self.state_store,
+                pruner=self.pruner,
+            )
+            self.grpc_privileged_server.start()
         for hostp, portp in self.config.p2p.persistent_peer_list():
             try:
                 self.switch.dial_peer(hostp, portp)
@@ -360,5 +387,9 @@ class Node:
             self.metrics_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
+        if self.grpc_privileged_server is not None:
+            self.grpc_privileged_server.stop()
         if hasattr(self.priv_validator, "close"):
             self.priv_validator.close()  # remote signer listener

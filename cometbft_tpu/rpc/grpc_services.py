@@ -1,0 +1,234 @@
+"""Node gRPC services (reference rpc/grpc/server/services/*):
+
+- VersionService.GetVersion
+- BlockService.GetByHeight / GetLatest
+- BlockResultsService.GetBlockResults
+- PruningService.Set/GetBlockRetainHeight,
+  Set/GetBlockResultsRetainHeight (the privileged data-companion API
+  feeding the pruner's companion retain heights)
+
+Hand-rolled request/response protos over grpc generic handlers (the
+image has grpcio but no codegen plugin; see abci/grpc_transport.py for
+the same pattern). The pruning service is intended for the PRIVILEGED
+listener: bind it to a separate loopback address, as the reference does
+(rpc/grpc/server privileged vs non-privileged servers).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+from ..encoding import proto as pb
+
+VERSION_SERVICE = "cometbft.services.version.v1.VersionService"
+BLOCK_SERVICE = "cometbft.services.block.v1.BlockService"
+BLOCK_RESULTS_SERVICE = (
+    "cometbft.services.block_results.v1.BlockResultsService"
+)
+PRUNING_SERVICE = "cometbft.services.pruning.v1.PruningService"
+
+_ident = bytes
+
+NODE_VERSION = "0.3.0"  # this framework's release version
+ABCI_VERSION = "2.1.0"
+P2P_PROTOCOL = 9
+BLOCK_PROTOCOL = 11
+
+
+class GrpcRPCServer:
+    """Non-privileged services (version/block/block results) plus,
+    when a pruner is supplied, the privileged pruning service."""
+
+    def __init__(self, addr: str, *, block_store=None, state_store=None,
+                 pruner=None, max_workers: int = 4):
+        import grpc
+
+        self.block_store = block_store
+        self.state_store = state_store
+        self.pruner = pruner
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._register(grpc)
+        hostport = addr.removeprefix("tcp://") or "127.0.0.1:0"
+        self.port = self._server.add_insecure_port(hostport)
+        self.addr = f"{hostport.rsplit(':', 1)[0]}:{self.port}"
+
+    # ------------------------------------------------------------------
+    def _register(self, grpc) -> None:
+        def h(fn):
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: fn(req),
+                request_deserializer=_ident,
+                response_serializer=_ident,
+            )
+
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                VERSION_SERVICE, {"GetVersion": h(self._get_version)}
+            ),
+            grpc.method_handlers_generic_handler(
+                BLOCK_SERVICE,
+                {
+                    "GetByHeight": h(self._get_by_height),
+                    "GetLatest": h(self._get_latest),
+                    "GetLatestHeight": h(self._get_latest_height),
+                },
+            ),
+            grpc.method_handlers_generic_handler(
+                BLOCK_RESULTS_SERVICE,
+                {"GetBlockResults": h(self._get_block_results)},
+            ),
+        ))
+        if self.pruner is not None:
+            self._server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    PRUNING_SERVICE,
+                    {
+                        "SetBlockRetainHeight": h(self._set_block_retain),
+                        "GetBlockRetainHeight": h(self._get_block_retain),
+                        "SetBlockResultsRetainHeight": h(
+                            self._set_results_retain
+                        ),
+                        "GetBlockResultsRetainHeight": h(
+                            self._get_results_retain
+                        ),
+                    },
+                ),
+            ))
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+    # -- version --------------------------------------------------------
+    def _get_version(self, req: bytes) -> bytes:
+        return (
+            pb.f_string(1, NODE_VERSION)
+            + pb.f_string(2, ABCI_VERSION)
+            + pb.f_varint(3, P2P_PROTOCOL)
+            + pb.f_varint(4, BLOCK_PROTOCOL)
+        )
+
+    # -- block ----------------------------------------------------------
+    def _block_response(self, height: int) -> bytes:
+        blk = self.block_store.load_block(height)
+        if blk is None:
+            raise ValueError(f"no block at height {height}")
+        bid = pb.f_bytes(1, blk.hash())
+        return pb.f_embedded(1, bid) + pb.f_embedded(2, blk.encode())
+
+    def _get_by_height(self, req: bytes) -> bytes:
+        d = pb.fields_to_dict(req)
+        return self._block_response(pb.to_i64(d.get(1, 0)))
+
+    def _get_latest(self, req: bytes) -> bytes:
+        return self._block_response(self.block_store.height())
+
+    def _get_latest_height(self, req: bytes) -> bytes:
+        return pb.f_varint(1, self.block_store.height())
+
+    # -- block results ---------------------------------------------------
+    def _get_block_results(self, req: bytes) -> bytes:
+        d = pb.fields_to_dict(req)
+        h = pb.to_i64(d.get(1, 0)) or self.block_store.height()
+        raw = (
+            self.state_store.load_finalize_response(h)
+            if self.state_store is not None else None
+        )
+        return pb.f_varint(1, h) + pb.f_bytes(2, raw or b"")
+
+    # -- pruning (privileged data-companion API) -------------------------
+    def _set_block_retain(self, req: bytes) -> bytes:
+        d = pb.fields_to_dict(req)
+        self.pruner.set_companion_block_retain_height(pb.to_i64(d.get(1, 0)))
+        return b""
+
+    def _get_block_retain(self, req: bytes) -> bytes:
+        return pb.f_varint(1, self.pruner.app_retain_height()) + pb.f_varint(
+            2, self.pruner.companion_block_retain_height()
+        )
+
+    def _set_results_retain(self, req: bytes) -> bytes:
+        d = pb.fields_to_dict(req)
+        self.pruner.set_companion_block_results_retain_height(
+            pb.to_i64(d.get(1, 0))
+        )
+        return b""
+
+    def _get_results_retain(self, req: bytes) -> bytes:
+        return pb.f_varint(
+            1, self.pruner.companion_block_results_retain_height()
+        )
+
+
+class GrpcRPCClient:
+    """Client for the services above (reference rpc/grpc/client)."""
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(addr.removeprefix("tcp://"))
+        self._timeout = timeout_s
+
+    def _call(self, service: str, method: str, payload: bytes = b"") -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        return fn(payload, timeout=self._timeout)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def get_version(self) -> dict:
+        d = pb.fields_to_dict(self._call(VERSION_SERVICE, "GetVersion"))
+        return {
+            "node": pb.as_bytes(d.get(1, b"")).decode(),
+            "abci": pb.as_bytes(d.get(2, b"")).decode(),
+            "p2p": pb.to_i64(d.get(3, 0)),
+            "block": pb.to_i64(d.get(4, 0)),
+        }
+
+    def get_block_by_height(self, height: int):
+        from ..types.block import Block
+
+        out = self._call(
+            BLOCK_SERVICE, "GetByHeight", pb.f_varint(1, height)
+        )
+        d = pb.fields_to_dict(out)
+        return Block.decode(pb.as_bytes(d.get(2, b"")))
+
+    def get_latest_height(self) -> int:
+        out = self._call(BLOCK_SERVICE, "GetLatestHeight")
+        return pb.to_i64(pb.fields_to_dict(out).get(1, 0))
+
+    def get_block_results(self, height: int = 0) -> tuple[int, bytes]:
+        out = self._call(
+            BLOCK_RESULTS_SERVICE, "GetBlockResults", pb.f_varint(1, height)
+        )
+        d = pb.fields_to_dict(out)
+        return pb.to_i64(d.get(1, 0)), pb.as_bytes(d.get(2, b""))
+
+    def set_block_retain_height(self, h: int) -> None:
+        self._call(PRUNING_SERVICE, "SetBlockRetainHeight", pb.f_varint(1, h))
+
+    def get_block_retain_height(self) -> tuple[int, int]:
+        d = pb.fields_to_dict(
+            self._call(PRUNING_SERVICE, "GetBlockRetainHeight")
+        )
+        return pb.to_i64(d.get(1, 0)), pb.to_i64(d.get(2, 0))
+
+    def set_block_results_retain_height(self, h: int) -> None:
+        self._call(
+            PRUNING_SERVICE, "SetBlockResultsRetainHeight", pb.f_varint(1, h)
+        )
+
+    def get_block_results_retain_height(self) -> int:
+        d = pb.fields_to_dict(
+            self._call(PRUNING_SERVICE, "GetBlockResultsRetainHeight")
+        )
+        return pb.to_i64(d.get(1, 0))

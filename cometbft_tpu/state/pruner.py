@@ -70,6 +70,12 @@ class Pruner:
     def app_retain_height(self) -> int:
         return self._get(_KEY_APP_RETAIN)
 
+    def companion_block_retain_height(self) -> int:
+        return self._get(_KEY_COMPANION_BLOCK)
+
+    def companion_block_results_retain_height(self) -> int:
+        return self._get(_KEY_COMPANION_RESULTS)
+
     def effective_retain_height(self) -> int:
         """min(app, companion) when the companion is enabled, else app
         (reference pruner.go findMinRetainHeight)."""
