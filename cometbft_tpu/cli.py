@@ -14,7 +14,7 @@ import shutil
 import sys
 import time
 
-VERSION = "0.2.0"  # round-2 line
+VERSION = "0.3.0"  # round-3 line
 
 
 def _cfg_paths(home: str):
@@ -176,6 +176,145 @@ def cmd_reset_all(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """reference cmd/cometbft/commands/light.go: run a light-client RPC
+    proxy against a primary + witnesses, anchored at a trusted
+    height/hash."""
+    from .light import LightClient, LightStore
+    from .light.provider_http import HTTPProvider
+    from .light.proxy import LightProxy
+
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [
+        HTTPProvider(args.chain_id, w)
+        for w in (args.witnesses.split(",") if args.witnesses else [])
+        if w
+    ]
+    lc = LightClient(
+        args.chain_id, primary, witnesses=witnesses, store=LightStore(),
+        trusting_period_s=args.trust_period,
+        backend=args.backend,
+    )
+    lc.initialize(args.trusted_height, bytes.fromhex(args.trusted_hash))
+    host, _, port = args.laddr.removeprefix("tcp://").rpartition(":")
+    proxy = LightProxy(lc, host or "127.0.0.1", int(port or 0))
+    proxy.start()
+    print(f"light proxy serving verified RPC on {proxy.addr} "
+          f"(primary {args.primary}, {len(witnesses)} witnesses)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """reference cmd/cometbft/commands/debug: capture a node's observable
+    state over RPC into a tarball for post-mortem analysis."""
+    import io
+    import tarfile
+    import urllib.request
+
+    def rpc(method):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": {}}).encode()
+        req = urllib.request.Request(
+            args.rpc, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.read()
+
+    captured = {}
+    for method in ("status", "net_info", "consensus_state",
+                   "consensus_params", "num_unconfirmed_txs", "genesis"):
+        try:
+            captured[f"{method}.json"] = rpc(method)
+        except Exception as e:  # noqa: BLE001 — capture what we can
+            captured[f"{method}.error"] = str(e).encode()
+    cfg_file = _cfg_paths(args.home)["config_file"]
+    if os.path.exists(cfg_file):
+        with open(cfg_file, "rb") as f:
+            captured["config.toml"] = f.read()
+    with tarfile.open(args.output, "w:gz") as tar:
+        for name, data in captured.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+    print(f"wrote {args.output} ({len(captured)} artifacts)")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """reference commands/compact.go (experimental-compact-goleveldb):
+    reclaim dead space in the node's sqlite stores."""
+    import sqlite3
+
+    p = _cfg_paths(args.home)
+    n = 0
+    for name in os.listdir(p["data"]):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(p["data"], name)
+        before = os.path.getsize(path)
+        con = sqlite3.connect(path)
+        con.execute("VACUUM")
+        con.close()
+        after = os.path.getsize(path)
+        print(f"{name}: {before} -> {after} bytes")
+        n += 1
+    if n == 0:
+        print("no .db files under data/ (mem backend?)")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """reference commands/reindex_event.go: rebuild the tx and block
+    indexes from the block store + stored ABCI responses."""
+    from .abci import wire as W
+    from .config import Config
+    from .storage import BlockStore, StateStore, open_kv
+    from .storage.indexer import BlockIndexer, TxIndexer
+    from .crypto.keys import tmhash
+
+    p = _cfg_paths(args.home)
+    cfg = Config.load(p["config_file"])
+    mem = cfg.base.db_backend == "mem"
+    if mem:
+        print("mem backend holds no persisted blocks to reindex")
+        return 1
+    bs = BlockStore(open_kv(os.path.join(args.home, "data/blockstore.db")))
+    ss = StateStore(open_kv(os.path.join(args.home, "data/state.db")))
+    txi = TxIndexer(open_kv(os.path.join(args.home, "data/tx_index.db")))
+    bli = BlockIndexer(open_kv(os.path.join(args.home, "data/block_index.db")))
+    start = args.start_height or bs.base() or 1
+    end = args.end_height or bs.height()
+    txs = blocks = 0
+    for h in range(start, end + 1):
+        blk = bs.load_block(h)
+        raw = ss.load_abci_responses(h)
+        if blk is None or raw is None:
+            continue
+        resp = W.dec_finalize_resp(raw)
+        bli.index(h, {"tm.event": ["NewBlock"],
+                      "block.height": [str(h)]})
+        blocks += 1
+        for i, tx in enumerate(blk.data.txs):
+            result = (
+                resp.tx_results[i] if i < len(resp.tx_results) else None
+            )
+            txi.index(h, i, tx, result, {
+                "tm.event": ["Tx"],
+                "tx.height": [str(h)],
+                "tx.hash": [tmhash(tx).hex().upper()],
+            })
+            txs += 1
+    print(f"reindexed heights [{start}, {end}]: "
+          f"{blocks} blocks, {txs} txs")
+    return 0
+
+
 def cmd_inspect_lite(args) -> int:
     """reference `cometbft inspect`: serve RPC over the stores of a
     stopped node, without consensus."""
@@ -249,6 +388,26 @@ def main(argv=None) -> int:
     sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("reset-all").set_defaults(fn=cmd_reset_all)
     sub.add_parser("inspect-lite").set_defaults(fn=cmd_inspect_lite)
+    sub.add_parser("inspect").set_defaults(fn=cmd_inspect_lite)
+    sp = sub.add_parser("light")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True)
+    sp.add_argument("--witnesses", default="")
+    sp.add_argument("--trusted-height", type=int, required=True)
+    sp.add_argument("--trusted-hash", required=True)
+    sp.add_argument("--trust-period", type=int, default=7 * 24 * 3600)
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--backend", default="cpu")
+    sp.set_defaults(fn=cmd_light)
+    sp = sub.add_parser("debug")
+    sp.add_argument("--rpc", default="http://127.0.0.1:26657")
+    sp.add_argument("--output", default="cometbft-debug.tar.gz")
+    sp.set_defaults(fn=cmd_debug)
+    sub.add_parser("compact-db").set_defaults(fn=cmd_compact_db)
+    sp = sub.add_parser("reindex-event")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
     sp = sub.add_parser("rollback")
     sp.add_argument("--hard", action="store_true",
                     help="also remove the pending block from the block store")

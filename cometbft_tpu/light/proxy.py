@@ -1,0 +1,116 @@
+"""Light-client RPC proxy (reference light/proxy/proxy.go).
+
+Serves a verified subset of the node RPC surface: every response is
+derived from light blocks the client has verified against its trust
+root (and cross-checked against witnesses), so a caller can point
+ordinary RPC tooling at the proxy and trust the answers without
+trusting the primary full node.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from ..rpc.routes import RPCError
+from ..rpc.server import RPCServer
+from ..types import Timestamp
+from .client import LightClient
+
+
+def _hx(b: bytes | None) -> str:
+    return (b or b"").hex().upper()
+
+
+class LightProxy:
+    def __init__(self, client: LightClient, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.client = client
+        routes = {
+            "health": self._health,
+            "status": self._status,
+            "commit": self._commit,
+            "header": self._header,
+            "validators": self._validators,
+        }
+        # route signature parity with rpc.routes: fn(env, params)
+        self._server = RPCServer(
+            env=None, host=host, port=port,
+            routes={k: (lambda e, p, f=v: f(p)) for k, v in routes.items()},
+        )
+
+    @property
+    def addr(self):
+        return self._server.addr
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # ------------------------------------------------------------------
+    def _verified(self, params):
+        h = int(params.get("height", 0) or 0)
+        now = Timestamp.from_unix_ns(_time.time_ns())
+        try:
+            if h <= 0:
+                latest = self.client.store.latest()
+                if latest is None:
+                    raise RPCError(-32603, "light client not initialized")
+                return latest
+            return self.client.verify_to_height(h, now)
+        except RPCError:
+            raise
+        except Exception as e:  # noqa: BLE001 — verification failure
+            raise RPCError(-32603, f"light verification failed: {e}") from e
+
+    def _health(self, params):
+        return {}
+
+    def _status(self, params):
+        latest = self.client.store.latest()
+        return {
+            "node_info": {"network": self.client.chain_id,
+                          "moniker": "light-proxy"},
+            "sync_info": {
+                "latest_block_height": str(latest.height if latest else 0),
+                "latest_block_hash": _hx(
+                    latest.signed_header.header.hash() if latest else b""
+                ),
+            },
+        }
+
+    def _commit(self, params):
+        from ..rpc.routes import _commit_json, _header_json
+
+        lb = self._verified(params)
+        return {
+            "signed_header": {
+                "header": _header_json(lb.signed_header.header),
+                "commit": _commit_json(lb.signed_header.commit),
+            },
+            "canonical": True,
+        }
+
+    def _header(self, params):
+        from ..rpc.routes import _header_json
+
+        return {"header": _header_json(self._verified(params).signed_header.header)}
+
+    def _validators(self, params):
+        lb = self._verified(params)
+        return {
+            "block_height": str(lb.height),
+            "validators": [
+                {
+                    "address": _hx(v.address),
+                    "pub_key": _hx(v.pub_key.bytes()),
+                    "pub_key_type": v.pub_key.type_tag(),
+                    "voting_power": str(v.voting_power),
+                    "proposer_priority": str(v.proposer_priority),
+                }
+                for v in lb.validators.validators
+            ],
+            "count": str(len(lb.validators)),
+            "total": str(len(lb.validators)),
+        }

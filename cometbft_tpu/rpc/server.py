@@ -61,8 +61,10 @@ def _ws_read_frame(rfile) -> tuple[int, bytes] | None:
 
 
 class RPCServer:
-    def __init__(self, env, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, env, host: str = "127.0.0.1", port: int = 0,
+                 routes=None):
         self.env = env
+        self.routes = ROUTES if routes is None else routes
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -105,7 +107,7 @@ class RPCServer:
             def _dispatch(self, req):
                 method = req.get("method", "")
                 rid = req.get("id", -1)
-                fn = ROUTES.get(method)
+                fn = outer.routes.get(method)
                 if fn is None:
                     return self._respond_err(rid, -32601,
                                              f"method {method} not found")

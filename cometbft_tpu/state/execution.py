@@ -325,6 +325,11 @@ class BlockExecutor:
             self.state_store.save_finalize_response(
                 block.header.height, results_hash(resp.tx_results)
             )
+            from ..abci import wire as _W
+
+            self.state_store.save_abci_responses(
+                block.header.height, _W.enc_finalize_resp(resp)
+            )
         if self.event_bus is not None:
             # fire events (reference execution.go:313 fireEvents)
             self.event_bus.publish_new_block(block, resp)

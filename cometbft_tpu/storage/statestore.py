@@ -82,12 +82,23 @@ class StateStore:
     def load_finalize_response(self, height: int) -> bytes | None:
         return self._db.get(_key_abci(height))
 
+    def save_abci_responses(self, height: int, payload: bytes) -> None:
+        """Full encoded FinalizeBlockResponse (reference
+        state/store.go SaveFinalizeBlockResponse) — what reindexing and
+        /block_results serve; save_finalize_response keeps only the
+        results hash the header commits to."""
+        self._db.set(b"AR:" + height.to_bytes(8, "big"), payload)
+
+    def load_abci_responses(self, height: int) -> bytes | None:
+        return self._db.get(b"AR:" + height.to_bytes(8, "big"))
+
     def prune(self, retain_height: int, current_height: int) -> int:
         deletes = []
         pruned = 0
         for h in range(1, retain_height):
             if self._db.has(_key_vals(h)) or self._db.has(_key_abci(h)):
-                deletes += [_key_vals(h), _key_abci(h), _key_params(h)]
+                deletes += [_key_vals(h), _key_abci(h), _key_params(h),
+                            b"AR:" + h.to_bytes(8, "big")]
                 pruned += 1
         if deletes:
             self._db.write_batch([], deletes)

@@ -49,3 +49,56 @@ def test_gen_commands(capsys):
     assert len(json.loads(capsys.readouterr().out)["id"]) == 40
     assert main(["gen-validator"]) == 0
     assert len(json.loads(capsys.readouterr().out)["pub_key"]) == 64
+
+
+def test_compact_reindex_debug(tmp_path):
+    """compact-db, reindex-event, and debug against a real stopped node
+    home (reference commands/compact.go, reindex_event.go, debug)."""
+    import os
+    import tarfile
+    import threading
+    import time as _time
+
+    from cometbft_tpu.cli import main
+    from cometbft_tpu.storage import BlockStore, open_kv
+    from cometbft_tpu.storage.indexer import TxIndexer
+
+    home = str(tmp_path / "n0")
+    # a 1-validator net that commits a few tx-bearing blocks
+    assert main(["--home", home, "init", "--chain-id", "cli-chain"]) == 0
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+
+    cfg = Config.load(os.path.join(home, "config/config.toml"))
+    cfg.base.home = home
+    cfg.base.db_backend = "sqlite"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_commit = 0.05
+    node = Node(cfg, app=KVStoreApp())
+    node.start()
+    node.mempool.check_tx(b"cli=test")
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        if node.consensus.sm_state.last_block_height >= 3:
+            break
+        _time.sleep(0.1)
+    rhost, rport = node.rpc_addr
+    # debug runs against the LIVE node
+    out_tar = str(tmp_path / "debug.tar.gz")
+    assert main(["debug", "--rpc", f"http://{rhost}:{rport}",
+                 "--output", out_tar]) == 0
+    with tarfile.open(out_tar) as tar:
+        names = tar.getnames()
+    assert "status.json" in names and "consensus_state.json" in names
+    node.stop()
+    # reindex + compact run against the stopped home
+    assert main(["--home", home, "reindex-event"]) == 0
+    txi = TxIndexer(open_kv(os.path.join(home, "data/tx_index.db")))
+    from cometbft_tpu.crypto.keys import tmhash
+
+    rec = txi.get(tmhash(b"cli=test"))
+    assert rec is not None
+    assert main(["--home", home, "compact-db"]) == 0

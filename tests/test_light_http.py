@@ -153,3 +153,80 @@ def test_broadcast_evidence_route(tmp_path):
     assert pool.added and out["hash"]
     with pytest.raises(RPCError):
         cli.call("broadcast_evidence", {"evidence": "zz-not-hex"})
+
+
+def test_light_proxy_serves_verified_rpc(tmp_path):
+    """The light proxy answers RPC queries only with light-client-verified
+    data (reference light/proxy/proxy.go)."""
+    import urllib.request
+
+    tmp_path = str(tmp_path)
+    pvs = [FilePV.generate(None, None) for _ in range(2)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    keys = [
+        {
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }
+        for pv in pvs
+    ]
+    n0 = _mk_node(tmp_path, "n0", keys[0], genesis, rpc=True)
+    n0.start()
+    host, port = n0.listen_addr
+    n1 = _mk_node(tmp_path, "n1", keys[1], genesis, peers=f"{host}:{port}")
+    n1.start()
+    proxy = None
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if n0.consensus.sm_state.last_block_height >= 4:
+                break
+            time.sleep(0.2)
+        assert n0.consensus.sm_state.last_block_height >= 4
+
+        from cometbft_tpu.light import LightClient, LightStore
+        from cometbft_tpu.light.provider_http import HTTPProvider
+        from cometbft_tpu.light.proxy import LightProxy
+
+        rhost, rport = n0.rpc_addr
+        provider = HTTPProvider(CHAIN, f"http://{rhost}:{rport}")
+        anchor = provider.light_block(1)
+        lc = LightClient(CHAIN, provider, store=LightStore(),
+                         trusting_period_s=10**9, backend="cpu")
+        lc.initialize(1, anchor.signed_header.header.hash())
+        proxy = LightProxy(lc)
+        proxy.start()
+        phost, pport = proxy.addr
+
+        def call(method, params):
+            body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                               "params": params}).encode()
+            req = urllib.request.Request(
+                f"http://{phost}:{pport}", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        out = call("commit", {"height": "3"})
+        hdr = out["result"]["signed_header"]["header"]
+        assert int(hdr["height"]) == 3
+        # the proxy's answer matches the full node's committed block
+        full = n0.block_store.load_block(3)
+        assert hdr["app_hash"] == full.header.app_hash.hex().upper()
+        vals = call("validators", {"height": "3"})["result"]
+        assert int(vals["count"]) == 2
+        err = call("block", {"height": "2"})  # not a verified route
+        assert "error" in err
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        n1.stop()
+        n0.stop()

@@ -200,3 +200,28 @@ def test_mixed_curve_commit_verify():
                               backend="tpu")
     finally:
         V.BATCH_VERIFY_THRESHOLD = old
+
+
+def test_sr25519_rlc_batch_and_blame():
+    """Batches verify as one RLC multi-scalar multiplication; a corrupt
+    signature fails the combination and the per-signature fallback blames
+    exactly it (reference crypto/sr25519/batch.go semantics)."""
+    from cometbft_tpu.crypto.sr25519 import (
+        Sr25519BatchVerifier,
+        Sr25519PrivKey,
+    )
+
+    keys = [Sr25519PrivKey.from_secret(bytes([i]) * 32) for i in range(8)]
+    good = Sr25519BatchVerifier()
+    bad = Sr25519BatchVerifier()
+    for i, k in enumerate(keys):
+        msg = f"rlc-{i}".encode()
+        sig = k.sign(msg)
+        assert good.add(k.pub_key(), msg, sig)
+        if i == 5:
+            sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+        assert bad.add(k.pub_key(), msg, sig)
+    ok, bits = good.verify()
+    assert ok and all(bits)
+    ok, bits = bad.verify()
+    assert not ok and not bits[5] and sum(bits) == 7
