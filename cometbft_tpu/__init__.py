@@ -14,4 +14,17 @@ file:line citations in docstrings point at the reference implementation
 whose *behavior* (not code) each component mirrors.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
+
+# Persistent XLA compilation cache: the crypto kernels compile in tens
+# of seconds; without a disk cache every fresh process pays that again
+# before its first verification. Harmless when jax is never imported.
+import os as _os
+
+_os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    _os.path.join(
+        _os.environ.get("XDG_CACHE_HOME", _os.path.expanduser("~/.cache")),
+        "cometbft_tpu", "jax",
+    ),
+)

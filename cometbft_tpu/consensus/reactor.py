@@ -14,8 +14,9 @@ driven by each peer's advertised round state:
   current-round prevotes/precommits, POL prevotes, last-commit
   precommits for peers one height back, and stored commit signatures
   for peers further back (rs.Height >= prs.Height+2 -> LoadCommit).
-- VoteSetMaj23 queries are answered with VoteSetBits (:893 semantics;
-  the periodic query routine is not yet run).
+- queryMaj23Routine (:893): same-height peers are periodically told
+  which blocks we see +2/3 votes for; they answer with VoteSetBits
+  bitmaps that prune the vote gossip difference.
 
 Blocks never travel whole: the proposer splits them into 64 KiB merkle-
 proved parts (types/part_set.py, reference types/part_set.go) and every
@@ -294,6 +295,7 @@ class PeerState:
         self.catchup_parts: set[int] = set()  # parts sent for peer's height
         self.catchup_height = 0
         self.catchup_time = 0.0  # last catchup (re)start, for retry
+        self.last_maj23_query = 0.0
         # (height, round, type) -> set of validator indexes known to peer
         self.votes_seen: dict[tuple[int, int, int], set[int]] = {}
 
@@ -503,6 +505,17 @@ class ConsensusReactor(Reactor):
             self.cs.send(msg, peer_id=peer.id)
         elif isinstance(msg, VoteSetMaj23Message):
             self._answer_maj23(peer, msg)
+        elif isinstance(msg, VoteSetBitsMessage):
+            # the peer's bitmap for (height, round, type): every set bit
+            # is a vote we need not gossip to it (reference peer_state
+            # ApplyVoteSetBitsMessage)
+            bits = msg.bits
+            i = 0
+            while bits:
+                if bits & 1:
+                    ps.mark_vote(msg.height, msg.round, msg.type, i)
+                bits >>= 1
+                i += 1
 
     def _try_complete_locked(self, height: int, round_: int):
         """Caller holds self._lock. Returns assembled bytes when the
@@ -627,12 +640,40 @@ class ConsensusReactor(Reactor):
             try:
                 sent = self._gossip_data(ps)
                 sent = self._gossip_votes(ps) or sent
+                self._maybe_query_maj23(ps)
             except Exception as e:  # noqa: BLE001 — peer loops must survive
                 _log.warn("gossip error", peer=ps.peer.id[:8],
                           err=f"{type(e).__name__}: {e}"[:120])
                 sent = False
             if not sent:
                 time.sleep(self.GOSSIP_SLEEP_S)
+
+    def _maybe_query_maj23(self, ps: PeerState) -> None:
+        """Periodically tell a same-height peer which blocks we see +2/3
+        votes for; it answers with VoteSetBits so vote gossip skips what
+        it already has (reference queryMaj23Routine :893)."""
+        now = time.monotonic()
+        with ps.lock:
+            if now - ps.last_maj23_query < self.PEER_QUERY_MAJ23_INTERVAL_S:
+                return
+            ps.last_maj23_query = now
+            h = ps.height
+        cs = self.cs
+        if h != cs.height:
+            return
+        for vtype, vs in (
+            (SignedMsgType.PREVOTE, cs.votes.prevotes(cs.round)),
+            (SignedMsgType.PRECOMMIT, cs.votes.precommits(cs.round)),
+        ):
+            maj23 = getattr(vs, "maj23", None) if vs is not None else None
+            if maj23 is None:
+                continue
+            ps.peer.send(
+                STATE_CHANNEL,
+                encode_consensus_msg(
+                    VoteSetMaj23Message(cs.height, cs.round, vtype, maj23)
+                ),
+            )
 
     def _gossip_data(self, ps: PeerState) -> bool:
         cs = self.cs

@@ -1,0 +1,144 @@
+"""A real double-signing validator on a live 4-node net: the byzantine
+node itself signs and GOSSIPS conflicting prevotes every height; the
+honest supermajority must detect the equivocation, gossip the
+DuplicateVoteEvidence, and commit it into a block on every honest node
+(reference internal/consensus/byzantine_test.go
+TestByzantinePrevoteEquivocation)."""
+
+import json
+import os
+import time
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus.state import VoteMessage
+from cometbft_tpu.node import Node
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.types import Timestamp, Vote
+from cometbft_tpu.types.basic import BlockID, PartSetHeader
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.vote import SignedMsgType
+
+CHAIN = "byz4-chain"
+
+
+def _mk_node(tmp_path, name, pv_key, genesis, peers=""):
+    home = os.path.join(tmp_path, name)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = name
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.p2p.persistent_peers = peers
+    cfg.consensus.timeout_propose = 0.6
+    cfg.consensus.timeout_propose_delta = 0.2
+    cfg.consensus.timeout_prevote = 0.3
+    cfg.consensus.timeout_prevote_delta = 0.1
+    cfg.consensus.timeout_precommit = 0.3
+    cfg.consensus.timeout_precommit_delta = 0.1
+    cfg.consensus.timeout_commit = 0.1
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        json.dump(pv_key, f)
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    return Node(cfg, app=KVStoreApp())
+
+
+def _make_byzantine(node, pv):
+    """Wrap the node's vote signing so every honest prevote is shadowed
+    by a conflicting prevote for a fabricated block, signed with the raw
+    key (bypassing FilePV's double-sign protection, as a compromised
+    signer would) and broadcast through the normal gossip path."""
+    cs = node.consensus
+    orig = cs._sign_and_send_vote
+
+    def double_signing(vtype, block_id):
+        orig(vtype, block_id)
+        if vtype != SignedMsgType.PREVOTE or block_id is None or not block_id.hash:
+            return
+        idx, val = cs.validators.get_by_address(pv.pub_key().address())
+        evil_bid = BlockID(
+            hash=b"\xbb" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32),
+        )
+        evil = Vote(
+            type=SignedMsgType.PREVOTE,
+            height=cs.height,
+            round=cs.round,
+            block_id=evil_bid,
+            timestamp=Timestamp.from_unix_ns(cs.now_ns()),
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        evil.signature = pv._priv.sign(evil.sign_bytes(cs.chain_id))
+        # push straight onto each peer's vote channel: gossip only serves
+        # votes from the node's own vote sets, a byzantine sender bypasses
+        # that (reference byzantine_test.go sends via peer.TrySend)
+        from cometbft_tpu.consensus.reactor import (
+            VOTE_CHANNEL,
+            encode_consensus_msg,
+        )
+
+        raw = encode_consensus_msg(VoteMessage(evil))
+        for peer in node.switch.peers():
+            peer.send(VOTE_CHANNEL, raw)
+
+    cs._sign_and_send_vote = double_signing
+
+
+def test_double_signer_evidence_commits_on_all_honest_nodes(tmp_path):
+    tmp_path = str(tmp_path)
+    pvs = [FilePV.generate(None, None) for _ in range(4)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    keys = [
+        {
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }
+        for pv in pvs
+    ]
+    nodes = [_mk_node(tmp_path, "n0", keys[0], genesis)]
+    nodes[0].start()
+    host, port = nodes[0].listen_addr
+    for i in range(1, 4):
+        n = _mk_node(tmp_path, f"n{i}", keys[i], genesis, peers=f"{host}:{port}")
+        nodes.append(n)
+    # node 3 is byzantine: it equivocates on every prevote
+    _make_byzantine(nodes[3], pvs[3])
+    for n in nodes[1:]:
+        n.start()
+    honest = nodes[:3]
+    try:
+        committed_on = set()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(committed_on) < 3:
+            for i, node in enumerate(honest):
+                if i in committed_on:
+                    continue
+                for h in range(1, node.block_store.height() + 1):
+                    blk = node.block_store.load_block(h)
+                    if blk and blk.evidence:
+                        ev = blk.evidence[0]
+                        assert ev.vote_a.validator_address == (
+                            pvs[3].pub_key().address()
+                        )
+                        committed_on.add(i)
+                        break
+            time.sleep(0.25)
+        assert committed_on == {0, 1, 2}, (
+            f"evidence committed on honest nodes {committed_on}, want all 3"
+        )
+    finally:
+        for n in reversed(nodes):
+            n.stop()

@@ -1,0 +1,142 @@
+"""Consensus-path workload benchmarks -> WORKLOADS.json.
+
+Three production shapes (SURVEY §3.3 / BASELINE configs):
+  1. verify_commit_p50_150v — one Cosmos-Hub-sized commit through
+     types.validation.verify_commit with the default backend dispatch
+     (commit-sized batches route to the native C++ RLC engine).
+  2. light_stream_1000h_150v — light-client verify_stream over 1000
+     contiguous headers (one signature mega-batch).
+  3. replay_500b_100v — block-store replay of 500 blocks through the
+     batched ReplayEngine (blocksync's consumption shape).
+
+Run: python tools/workloads.py [--quick]
+Each metric prints one JSON line; all are written to WORKLOADS.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+QUICK = "--quick" in sys.argv
+
+
+def _signed_chain(n_blocks, n_vals):
+    from cometbft_tpu.utils import factories as fx
+
+    return fx.make_chain(
+        n_blocks, n_validators=n_vals, chain_id="bench-chain", backend="cpu"
+    )
+
+
+def bench_verify_commit(n_vals=150, reps=31):
+    from cometbft_tpu.types.block import block_id_for
+    from cometbft_tpu.types.validation import verify_commit
+
+    store, state, genesis, _ = _signed_chain(3, n_vals)
+    blk = store.load_block(3)
+    commit = store.load_block_commit(3) or store.load_seen_commit(3)
+    vals = state.validators
+    block_id = commit.block_id
+    chain_id = state.chain_id
+    times = []
+    for _ in range(3):  # warmup (library load, table init)
+        verify_commit(chain_id, vals, block_id, 3, commit)
+    for _ in range(reps if not QUICK else 5):
+        t0 = time.perf_counter()
+        verify_commit(chain_id, vals, block_id, 3, commit)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+    return {
+        "metric": f"verify_commit_p50_{n_vals}v",
+        "value": round(p50 * 1e3, 3),
+        "unit": "ms",
+        "sigs_per_sec": round(n_vals / p50, 1),
+    }
+
+
+def bench_light_stream(n_headers=1000, n_vals=150):
+    from cometbft_tpu.light.client import StoreProvider
+    from cometbft_tpu.light.verifier import verify_stream
+    from cometbft_tpu.state.types import encode_validator_set
+    from cometbft_tpu.storage import MemKV, StateStore
+    from cometbft_tpu.types import Timestamp
+
+    if QUICK:
+        n_headers = 100
+    store, state, genesis, _ = _signed_chain(n_headers + 1, n_vals)
+    ss = StateStore(MemKV())
+    for h in range(1, n_headers + 2):
+        ss._db.set(
+            b"SV:" + h.to_bytes(8, "big"),
+            encode_validator_set(state.validators),
+        )
+    p = StoreProvider(state.chain_id, store, ss)
+    trusted = p.light_block(1)
+    stream = [p.light_block(h) for h in range(2, n_headers + 2)]
+    now = Timestamp.from_unix_ns(1_700_009_000 * 10**9)
+    # steady-state measurement: a long-running light client traces +
+    # compiles each kernel bucket once per process, not per stream
+    verify_stream(state.chain_id, trusted, stream, 10**9, now)
+    t0 = time.perf_counter()
+    verify_stream(state.chain_id, trusted, stream, 10**9, now)
+    dt = time.perf_counter() - t0
+    sigs = len(stream) * n_vals
+    return {
+        "metric": f"light_stream_{n_headers}h_{n_vals}v",
+        "value": round(dt, 3),
+        "unit": "s",
+        "headers_per_sec": round(len(stream) / dt, 1),
+        "sigs_per_sec": round(sigs / dt, 1),
+    }
+
+
+def bench_replay(n_blocks=500, n_vals=100):
+    from cometbft_tpu.abci.client import AppConns
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.blocksync import ReplayEngine
+    from cometbft_tpu.state.execution import BlockExecutor
+
+    if QUICK:
+        n_blocks = 50
+    store, final_state, genesis, _ = _signed_chain(n_blocks, n_vals)
+    # steady-state: trace/compile the replay window's kernel bucket once
+    # (a syncing node replays far more than one 500-block span)
+    warm = ReplayEngine(
+        store, BlockExecutor(AppConns(KVStoreApp())),
+        verify_mode="batched", window=32,
+    )
+    warm.run(genesis.copy())
+    executor = BlockExecutor(AppConns(KVStoreApp()))
+    engine = ReplayEngine(store, executor, verify_mode="batched", window=32)
+    t0 = time.perf_counter()
+    state, stats = engine.run(genesis.copy())
+    dt = time.perf_counter() - t0
+    assert state.last_block_height == n_blocks
+    assert state.app_hash == final_state.app_hash
+    return {
+        "metric": f"replay_{n_blocks}b_{n_vals}v",
+        "value": round(dt, 3),
+        "unit": "s",
+        "blocks_per_sec": round(n_blocks / dt, 1),
+        "sigs_per_sec": round(stats.sigs_verified / dt, 1),
+    }
+
+
+def main():
+    out = []
+    for fn in (bench_verify_commit, bench_light_stream, bench_replay):
+        rec = fn()
+        print(json.dumps(rec))
+        out.append(rec)
+    path = os.path.join(os.path.dirname(__file__), "..", "WORKLOADS.json")
+    with open(path, "w") as f:
+        for rec in out:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
