@@ -1,0 +1,50 @@
+"""Amino-compatible JSON for keys (reference crypto go-amino registry,
+e.g. privval key files and genesis docs in the classic format):
+
+    {"type": "tendermint/PubKeyEd25519", "value": "<base64>"}
+
+The framework's own files use explicit hex + type fields; this codec
+exists for interop with reference-formatted priv_validator_key.json /
+genesis.json documents.
+"""
+
+from __future__ import annotations
+
+import base64
+
+
+def pub_key_to_json(pk) -> dict:
+    return {
+        "type": pk.type_tag(),
+        "value": base64.b64encode(pk.bytes()).decode(),
+    }
+
+
+def pub_key_from_json(d: dict):
+    from ..rpc.codec import pub_key_from_json as _mk
+
+    return _mk(d.get("type", ""), base64.b64decode(d.get("value", "")))
+
+
+def priv_key_to_json(pk) -> dict:
+    tag = pk.type_tag().replace("PubKey", "PrivKey")
+    return {
+        "type": tag,
+        "value": base64.b64encode(pk.bytes()).decode(),
+    }
+
+
+def priv_key_from_json(d: dict):
+    tag = d.get("type", "")
+    raw = base64.b64decode(d.get("value", ""))
+    if "Secp256k1" in tag:
+        from ..crypto.secp256k1 import Secp256k1PrivKey
+
+        return Secp256k1PrivKey(raw)
+    if "Sr25519" in tag:
+        from ..crypto.sr25519 import Sr25519PrivKey
+
+        return Sr25519PrivKey(raw)
+    from ..crypto.ed25519 import Ed25519PrivKey
+
+    return Ed25519PrivKey(raw)

@@ -1,0 +1,138 @@
+"""Size-rotated file groups (reference libs/autofile/group.go).
+
+A Group writes to `<path>` (the head) and rotates it to
+`<path>.000`, `<path>.001`, … when the head exceeds head_size_limit,
+deleting the oldest chunks once the whole group exceeds
+total_size_limit. GroupReader replays the group in order across chunk
+boundaries. The consensus WAL keeps its own CRC-framed rotation (it
+predates this utility); Group is the general-purpose building block the
+reference exposes for any append-heavy log.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class Group:
+    def __init__(self, head_path: str,
+                 head_size_limit: int = 10 * 1024 * 1024,
+                 total_size_limit: int = 1024 * 1024 * 1024):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._head = open(head_path, "ab")
+        self.min_index, self.max_index = self._scan_indexes()
+
+    def _scan_indexes(self) -> tuple[int, int]:
+        base = os.path.basename(self.head_path)
+        d = os.path.dirname(self.head_path) or "."
+        idx = [
+            int(name[len(base) + 1:])
+            for name in os.listdir(d)
+            if name.startswith(base + ".")
+            and name[len(base) + 1:].isdigit()
+        ]
+        return (min(idx), max(idx)) if idx else (0, -1)
+
+    # ------------------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            self._head.write(data)
+
+    def write_line(self, line: str) -> None:
+        self.write(line.encode() + b"\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            self._head.flush()
+            os.fsync(self._head.fileno())
+
+    def head_size(self) -> int:
+        with self._lock:
+            self._head.flush()
+            return os.path.getsize(self.head_path)
+
+    def total_size(self) -> int:
+        total = self.head_size()
+        for i in range(self.min_index, self.max_index + 1):
+            try:
+                total += os.path.getsize(f"{self.head_path}.{i:03d}")
+            except FileNotFoundError:
+                pass
+        return total
+
+    def maybe_rotate(self) -> bool:
+        """Rotate when the head is over its limit; prune oldest chunks
+        while the group is over the total limit (checkHeadSizeLimit +
+        checkTotalSizeLimit in the reference's processTicks)."""
+        rotated = False
+        if self.head_size() > self.head_size_limit:
+            with self._lock:
+                self._head.close()
+                self.max_index += 1
+                os.rename(
+                    self.head_path, f"{self.head_path}.{self.max_index:03d}"
+                )
+                self._head = open(self.head_path, "ab")
+                rotated = True
+        while (
+            self.total_size() > self.total_size_limit
+            and self.min_index <= self.max_index
+        ):
+            try:
+                os.unlink(f"{self.head_path}.{self.min_index:03d}")
+            except FileNotFoundError:
+                pass
+            self.min_index += 1
+        return rotated
+
+    def close(self) -> None:
+        with self._lock:
+            self._head.close()
+
+    # ------------------------------------------------------------------
+    def reader(self):
+        return GroupReader(self)
+
+
+class GroupReader:
+    """Reads the whole group oldest-chunk-first, then the head."""
+
+    def __init__(self, group: Group):
+        self._paths = [
+            f"{group.head_path}.{i:03d}"
+            for i in range(group.min_index, group.max_index + 1)
+            if os.path.exists(f"{group.head_path}.{i:03d}")
+        ]
+        self._paths.append(group.head_path)
+        self._idx = 0
+        self._f = None
+
+    def read(self, n: int = -1) -> bytes:
+        out = b""
+        while n < 0 or len(out) < n:
+            if self._f is None:
+                if self._idx >= len(self._paths):
+                    break
+                self._f = open(self._paths[self._idx], "rb")
+            chunk = self._f.read(n - len(out) if n >= 0 else -1)
+            if not chunk:
+                self._f.close()
+                self._f = None
+                self._idx += 1
+                continue
+            out += chunk
+        return out
+
+    def lines(self):
+        buf = self.read()
+        for line in buf.splitlines():
+            yield line.decode()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()

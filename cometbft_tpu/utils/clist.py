@@ -1,0 +1,109 @@
+"""Concurrent doubly-linked list with blocking iteration (reference
+libs/clist/clist.go).
+
+The reference's mempool/evidence gossip routines park on the list tail:
+`front()` / `CElement.next_wait()` block until an element exists, so a
+gossip goroutine wakes exactly when there is something new to send
+instead of polling. Removal keeps detached elements traversable
+(`removed` flag + next/prev kept) so an iterator standing on a removed
+element can step off it, exactly as the reference documents.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class CElement:
+    __slots__ = ("value", "_next", "_prev", "removed", "_cond")
+
+    def __init__(self, value, cond: threading.Condition):
+        self.value = value
+        self._next: CElement | None = None
+        self._prev: CElement | None = None
+        self.removed = False
+        self._cond = cond
+
+    def next(self) -> "CElement | None":
+        with self._cond:
+            return self._next
+
+    def prev(self) -> "CElement | None":
+        with self._cond:
+            return self._prev
+
+    def next_wait(self, timeout: float | None = None) -> "CElement | None":
+        """Block until this element has a successor OR it is removed
+        (a removed element's next is whatever followed it)."""
+        with self._cond:
+            while self._next is None and not self.removed:
+                if not self._cond.wait(timeout):
+                    return None
+            return self._next
+
+
+class CList:
+    def __init__(self, max_len: int | None = None):
+        self._cond = threading.Condition()
+        self._head: CElement | None = None
+        self._tail: CElement | None = None
+        self._len = 0
+        self.max_len = max_len
+
+    def __len__(self) -> int:
+        with self._cond:
+            return self._len
+
+    def front(self) -> CElement | None:
+        with self._cond:
+            return self._head
+
+    def back(self) -> CElement | None:
+        with self._cond:
+            return self._tail
+
+    def front_wait(self, timeout: float | None = None) -> CElement | None:
+        with self._cond:
+            while self._head is None:
+                if not self._cond.wait(timeout):
+                    return None
+            return self._head
+
+    def push_back(self, value) -> CElement:
+        with self._cond:
+            if self.max_len is not None and self._len >= self.max_len:
+                raise OverflowError(f"clist maxed at {self.max_len}")
+            el = CElement(value, self._cond)
+            el._prev = self._tail
+            if self._tail is not None:
+                self._tail._next = el
+            else:
+                self._head = el
+            self._tail = el
+            self._len += 1
+            self._cond.notify_all()
+            return el
+
+    def remove(self, el: CElement) -> None:
+        with self._cond:
+            if el.removed:
+                return
+            el.removed = True
+            if el._prev is not None:
+                el._prev._next = el._next
+            else:
+                self._head = el._next
+            if el._next is not None:
+                el._next._prev = el._prev
+            else:
+                self._tail = el._prev
+            self._len -= 1
+            # wake waiters parked on el.next_wait(): removal is progress
+            self._cond.notify_all()
+
+    def __iter__(self):
+        el = self.front()
+        while el is not None:
+            if not el.removed:
+                yield el.value
+            el = el.next()

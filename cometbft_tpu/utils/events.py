@@ -1,0 +1,35 @@
+"""Synchronous event switch (reference libs/events/events.go).
+
+The older fire-and-listen callback registry the reference keeps beside
+the query-based pubsub event bus: listeners register per event name and
+fire_event invokes them inline. Used where a component wants plain
+callbacks without subscription plumbing (the reference's consensus
+internals use it for round-state notifications).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class EventSwitch:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # event name -> {listener id -> callback}
+        self._listeners: dict[str, dict[str, object]] = {}
+
+    def add_listener(self, listener_id: str, event: str, cb) -> None:
+        with self._lock:
+            self._listeners.setdefault(event, {})[listener_id] = cb
+
+    def remove_listener(self, listener_id: str, event: str | None = None) -> None:
+        with self._lock:
+            events = [event] if event else list(self._listeners)
+            for e in events:
+                self._listeners.get(e, {}).pop(listener_id, None)
+
+    def fire_event(self, event: str, data=None) -> None:
+        with self._lock:
+            cbs = list(self._listeners.get(event, {}).values())
+        for cb in cbs:
+            cb(data)

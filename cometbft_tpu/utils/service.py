@@ -1,0 +1,93 @@
+"""Service lifecycle base (reference libs/service/service.go).
+
+BaseService gives every long-running component the same contract the
+reference enforces: idempotent start (ErrAlreadyStarted), stop exactly
+once (ErrAlreadyStopped), a quit event background loops select on, wait
+for termination, and reset-after-stop. Subclasses implement on_start /
+on_stop; the provided `spawn` helper tracks daemon threads so stop can
+join them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ErrAlreadyStarted(RuntimeError):
+    pass
+
+
+class ErrAlreadyStopped(RuntimeError):
+    pass
+
+
+class BaseService:
+    def __init__(self, name: str | None = None):
+        self.name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self._mtx = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                raise ErrAlreadyStopped(
+                    f"{self.name} stopped; reset() before restarting"
+                )
+            if self._started:
+                raise ErrAlreadyStarted(f"{self.name} already started")
+            self._started = True
+        self.on_start()
+
+    def stop(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                raise ErrAlreadyStopped(f"{self.name} already stopped")
+            self._stopped = True
+        self._quit.set()
+        self.on_stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def reset(self) -> None:
+        """Stop -> reset -> start is the reference's restart contract
+        (service.go Reset: only valid on a stopped service)."""
+        with self._mtx:
+            if not self._stopped:
+                raise RuntimeError(f"{self.name} must be stopped to reset")
+            self._started = False
+            self._stopped = False
+            self._quit = threading.Event()
+            self._threads = []
+
+    def is_running(self) -> bool:
+        with self._mtx:
+            return self._started and not self._stopped
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the service quits (reference Service.Wait)."""
+        return self._quit.wait(timeout)
+
+    @property
+    def quit(self) -> threading.Event:
+        return self._quit
+
+    # -- template hooks -------------------------------------------------
+    def on_start(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+    def on_stop(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+    # -- helpers --------------------------------------------------------
+    def spawn(self, fn, *args, name: str | None = None) -> threading.Thread:
+        t = threading.Thread(
+            target=fn, args=args, daemon=True,
+            name=name or f"{self.name}-worker",
+        )
+        self._threads.append(t)
+        t.start()
+        return t

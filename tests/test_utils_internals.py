@@ -1,0 +1,141 @@
+"""Utility internals: service lifecycle, clist, autofile group, event
+switch, amino-JSON keys (reference libs/service, libs/clist,
+libs/autofile, libs/events, go-amino JSON)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.utils.autofile import Group
+from cometbft_tpu.utils.clist import CList
+from cometbft_tpu.utils.events import EventSwitch
+from cometbft_tpu.utils.service import (
+    BaseService,
+    ErrAlreadyStarted,
+    ErrAlreadyStopped,
+)
+
+
+def test_service_lifecycle():
+    events = []
+
+    class S(BaseService):
+        def on_start(self):
+            events.append("start")
+            self.spawn(self._loop)
+
+        def _loop(self):
+            self.quit.wait(5)
+            events.append("loop-exit")
+
+        def on_stop(self):
+            events.append("stop")
+
+    s = S()
+    assert not s.is_running()
+    s.start()
+    assert s.is_running()
+    with pytest.raises(ErrAlreadyStarted):
+        s.start()
+    s.stop()
+    assert not s.is_running()
+    with pytest.raises(ErrAlreadyStopped):
+        s.stop()
+    with pytest.raises(ErrAlreadyStopped):
+        s.start()  # stopped services need reset first
+    assert events[0] == "start" and set(events) == {
+        "start", "stop", "loop-exit"
+    }
+    s.reset()
+    s.start()
+    s.stop()
+
+
+def test_clist_push_remove_iterate():
+    cl = CList()
+    els = [cl.push_back(i) for i in range(5)]
+    assert list(cl) == [0, 1, 2, 3, 4]
+    cl.remove(els[2])
+    assert list(cl) == [0, 1, 3, 4] and len(cl) == 4
+    # iterator standing on a removed element steps off it
+    assert els[2].next().value == 3
+    cl.remove(els[0])
+    assert cl.front().value == 1
+    cl.remove(els[4])
+    assert cl.back().value == 3
+    with pytest.raises(OverflowError):
+        small = CList(max_len=1)
+        small.push_back(1)
+        small.push_back(2)
+
+
+def test_clist_blocking_wait():
+    cl = CList()
+    got = []
+
+    def consumer():
+        el = cl.front_wait(timeout=5)
+        while el is not None and len(got) < 3:
+            got.append(el.value)
+            el = el.next_wait(timeout=5)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    for i in range(3):
+        cl.push_back(i)
+        time.sleep(0.01)
+    t.join(timeout=5)
+    assert got == [0, 1, 2]
+
+
+def test_autofile_group_rotation(tmp_path):
+    head = str(tmp_path / "wal" / "log")
+    g = Group(head, head_size_limit=100, total_size_limit=350)
+    for i in range(10):
+        g.write_line(f"entry-{i:02d}" + "x" * 40)
+        g.maybe_rotate()
+    assert g.max_index >= 1  # rotated at least once
+    assert g.total_size() <= 350 + 100  # pruned to bound
+    lines = list(g.reader().lines())
+    # whatever survived pruning is contiguous and ends with the newest
+    assert lines[-1].startswith("entry-09")
+    nums = [int(ln[6:8]) for ln in lines]
+    assert nums == sorted(nums)
+    g.close()
+
+
+def test_event_switch():
+    es = EventSwitch()
+    seen = []
+    es.add_listener("a", "vote", lambda d: seen.append(("a", d)))
+    es.add_listener("b", "vote", lambda d: seen.append(("b", d)))
+    es.fire_event("vote", 1)
+    es.remove_listener("a", "vote")
+    es.fire_event("vote", 2)
+    es.fire_event("other", 3)  # no listeners: no-op
+    assert seen == [("a", 1), ("b", 1), ("b", 2)]
+
+
+def test_amino_json_keys_roundtrip():
+    from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey
+    from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from cometbft_tpu.encoding.amino_json import (
+        priv_key_from_json,
+        priv_key_to_json,
+        pub_key_from_json,
+        pub_key_to_json,
+    )
+
+    for priv in (Ed25519PrivKey.generate(), Secp256k1PrivKey.generate()):
+        pub = priv.pub_key()
+        d = pub_key_to_json(pub)
+        assert d["type"].startswith("tendermint/PubKey")
+        back = pub_key_from_json(d)
+        assert back.bytes() == pub.bytes()
+        assert back.address() == pub.address()
+        pd = priv_key_to_json(priv)
+        assert "PrivKey" in pd["type"]
+        pback = priv_key_from_json(pd)
+        assert pback.pub_key().bytes() == pub.bytes()
