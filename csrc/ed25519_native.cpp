@@ -21,6 +21,10 @@
 #include <atomic>
 #include <vector>
 #include <array>
+#include <string>
+#include <unordered_map>
+#include <mutex>
+#include <shared_mutex>
 
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
@@ -790,6 +794,33 @@ static void straus_sb_ka(P *o, const u8 s[32], const u8 k[32], const P *negA) {
 
 }  // namespace ge
 
+// Decoded-pubkey cache shared by single and batch verification: commit
+// verification re-checks the SAME validator set every height, so the
+// sqrt exponentiation per A — roughly a third of the single-verify cost
+// — runs once per validator. Decompression is deterministic, so caching
+// the negated point by its 32-byte encoding is sound.
+static std::unordered_map<std::string, ge::P> g_negA_cache;
+static std::shared_mutex g_negA_mtx;
+
+static bool cached_neg_decompress(ge::P *negA, const u8 pub[32]) {
+    std::string key((const char *)pub, 32);
+    {
+        std::shared_lock<std::shared_mutex> rl(g_negA_mtx);
+        auto it = g_negA_cache.find(key);
+        if (it != g_negA_cache.end()) {
+            *negA = it->second;
+            return true;
+        }
+    }
+    ge::P A;
+    if (!ge::decompress(&A, pub)) return false;
+    ge::neg(negA, &A);
+    std::unique_lock<std::shared_mutex> wl(g_negA_mtx);
+    if (g_negA_cache.size() > 65536) g_negA_cache.clear();
+    g_negA_cache.emplace(std::move(key), *negA);
+    return true;
+}
+
 // ------------------------------------------------------- public ABI ------
 extern "C" {
 
@@ -800,8 +831,8 @@ int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
     u64 s_words[4];
     sc::from_bytes(s_words, sig + 32);
     if (sc::cmp(s_words, sc::L) >= 0) return 0;
-    ge::P A, R;
-    if (!ge::decompress(&A, pub)) return 0;
+    ge::P negA_c, R;
+    if (!cached_neg_decompress(&negA_c, pub)) return 0;
     if (!ge::decompress(&R, sig)) return 0;
     // k = SHA512(R || A || M) mod L
     u8 digest[64];
@@ -811,10 +842,9 @@ int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
     u8 kb[32];
     sc::to_bytes(kb, k);
     // check [8]([S]B + [k](-A) - R) == identity, one Straus chain
-    ge::P negA, negR, acc;
-    ge::neg(&negA, &A);
+    ge::P negR, acc;
     ge::neg(&negR, &R);
-    ge::straus_sb_ka(&acc, sig + 32, kb, &negA);
+    ge::straus_sb_ka(&acc, sig + 32, kb, &negA_c);
     ge::add(&acc, &acc, &negR);
     ge::dbl(&acc, &acc);
     ge::dbl(&acc, &acc);
@@ -872,12 +902,15 @@ int ed25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
             u64 s_words[4];
             sc::from_bytes(s_words, sig + 32);
             if (sc::cmp(s_words, sc::L) >= 0) { ok.store(0); break; }
-            ge::P A, R;
-            if (!ge::decompress(&A, pub) || !ge::decompress(&R, sig)) {
+            ge::P R;
+            if (!cached_neg_decompress(&negA[i], pub)) {
                 ok.store(0);
                 break;
             }
-            ge::neg(&negA[i], &A);
+            if (!ge::decompress(&R, sig)) {
+                ok.store(0);
+                break;
+            }
             ge::neg(&negR[i], &R);
             u8 digest[64];
             sha512::hash(sig, 32, pub, 32, msgs + offsets[i], msg_lens[i],
