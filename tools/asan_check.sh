@@ -1,0 +1,9 @@
+#!/bin/sh
+# ASAN/UBSAN build + run of the native Ed25519 engine (SURVEY §5.2's
+# sanitizer leg for csrc; the Python suite covers the logic, this
+# catches memory errors the .so build would hide).
+set -e
+cd "$(dirname "$0")/.."
+g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -pthread \
+    csrc/ed25519_native.cpp csrc/asan_selftest.cpp -o /tmp/ed25519_asan
+/tmp/ed25519_asan
