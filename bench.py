@@ -34,7 +34,7 @@ import time
 CPU_BASELINE_SIGS_PER_SEC = 1.0e6
 N_SIGS = 10_000
 N_COMMITS = 8  # pipeline depth (distinct commits in flight)
-N_ROUNDS = 3
+N_ROUNDS = 5
 
 
 def main():
