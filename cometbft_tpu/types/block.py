@@ -203,15 +203,33 @@ class Commit:
 
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
         """Rebuild the canonical precommit bytes validator idx signed
-        (reference types/block.go:879)."""
+        (reference types/block.go:879).
+
+        Byte-identical to canonical_vote_bytes; the commit-invariant
+        prefix (type, height, round, block id) and suffix (chain id) are
+        built once per Commit — verify_commit calls this for every
+        validator and the per-call proto assembly was half its cost."""
         cs = self.signatures[idx]
-        return canonical_vote_bytes(
-            SignedMsgType.PRECOMMIT,
-            self.height,
-            self.round,
-            cs.effective_block_id(self.block_id),
-            cs.timestamp,
-            chain_id,
+        cache = self.__dict__.get("_sb_cache")
+        if cache is None or cache[0] != chain_id:
+            head = (
+                pb.f_varint(1, int(SignedMsgType.PRECOMMIT))
+                + pb.f_sfixed64(2, self.height)
+                + pb.f_sfixed64(3, self.round)
+            )
+            cache = (
+                chain_id,
+                head + pb.f_embedded_opt(4, self.block_id.encode_canonical()),
+                head + pb.f_embedded_opt(4, ZERO_BLOCK_ID.encode_canonical()),
+                pb.f_string(6, chain_id),
+            )
+            self.__dict__["_sb_cache"] = cache
+        _, with_bid, nil_bid, tail = cache
+        prefix = (
+            with_bid if cs.block_id_flag == BlockIDFlag.COMMIT else nil_bid
+        )
+        return pb.length_prefixed(
+            prefix + pb.f_embedded(5, cs.timestamp.encode()) + tail
         )
 
     def encode(self) -> bytes:

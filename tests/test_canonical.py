@@ -80,3 +80,34 @@ def test_vote_proto_roundtrip():
 def test_timestamp_roundtrip():
     for ts in [ZERO_TIME, Timestamp(0, 0), Timestamp(1_700_000_000, 999_999_999)]:
         assert Timestamp.decode(ts.encode()) == ts
+
+
+def test_commit_vote_sign_bytes_matches_canonical():
+    """The cached-prefix fast path in Commit.vote_sign_bytes must stay
+    byte-identical to canonical_vote_bytes for COMMIT, NIL, and ABSENT
+    slots across chain ids."""
+    from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+    from cometbft_tpu.types.block import BlockIDFlag, Commit, CommitSig
+    from cometbft_tpu.types.vote import SignedMsgType, canonical_vote_bytes
+
+    bid = BlockID(hash=b"\x17" * 32,
+                  part_set_header=PartSetHeader(4, b"\x29" * 32))
+    commit = Commit(
+        height=42, round=3, block_id=bid,
+        signatures=[
+            CommitSig(BlockIDFlag.COMMIT, b"\x01" * 20, Timestamp(9, 5),
+                      b"\xaa" * 64),
+            CommitSig(BlockIDFlag.NIL, b"\x02" * 20, Timestamp(11, 0),
+                      b"\xbb" * 64),
+            CommitSig(BlockIDFlag.COMMIT, b"\x03" * 20,
+                      Timestamp(123456789, 987654321), b"\xcc" * 64),
+        ],
+    )
+    for chain_id in ("chain-a", "another-chain"):
+        for idx, cs in enumerate(commit.signatures):
+            want = canonical_vote_bytes(
+                SignedMsgType.PRECOMMIT, commit.height, commit.round,
+                cs.effective_block_id(commit.block_id), cs.timestamp,
+                chain_id,
+            )
+            assert commit.vote_sign_bytes(chain_id, idx) == want
