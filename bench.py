@@ -33,7 +33,7 @@ import time
 
 CPU_BASELINE_SIGS_PER_SEC = 1.0e6
 N_SIGS = 10_000
-N_COMMITS = 8  # pipeline depth (distinct commits in flight)
+N_COMMITS = 16  # pipeline depth (amortizes the fixed D2H round trip)
 N_ROUNDS = 5
 
 
