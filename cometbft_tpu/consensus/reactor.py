@@ -45,6 +45,11 @@ STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 
+# Largest validator set any peer-supplied vote index or bitmap may claim
+# (reference MaxVotesCount = 10000); bounds HasVote indexes and the
+# VoteSetBits bit_length so one message cannot force millions of marks.
+MAX_VALIDATORS = 10_000
+
 _log = logger("cons-reactor")
 
 
@@ -317,12 +322,17 @@ class PeerState:
             self.last_commit_round = m.last_commit_round
 
     def mark_vote(self, height: int, round_: int, type_: int, index: int):
-        if index < 0:
+        if index < 0 or index > MAX_VALIDATORS:
             return
         with self.lock:
             self.votes_seen.setdefault((height, round_, int(type_)), set()).add(
                 index
             )
+            # votes_seen keys are peer-influenced (HasVote/VoteSetBits at
+            # arbitrary heights): bound the dict so junk heights cannot
+            # accumulate — oldest keys go first
+            while len(self.votes_seen) > 64:
+                self.votes_seen.pop(next(iter(self.votes_seen)))
 
     def has_vote(self, height: int, round_: int, type_: int, index: int) -> bool:
         with self.lock:
@@ -353,6 +363,7 @@ class ConsensusReactor(Reactor):
     MAX_HEADERLESS_PARTS = 256  # buffered before the proposal arrives
     MAX_VB_CANDIDATES = 4  # distinct NewValidBlock headers per height
     CATCHUP_CACHE_SIZE = 8  # committed-block PartSets kept for laggards
+    MAX_VALIDATORS = MAX_VALIDATORS  # per-message vote-index/bitmap cap
 
     def __init__(self, cs: ConsensusState, block_store=None):
         self.cs = cs
@@ -369,10 +380,12 @@ class ConsensusReactor(Reactor):
         self._assembling: dict[int, Part] = {}
         self._assembling_hdr: PartSetHeader | None = None
         self._assembling_hr: tuple[int, int] = (0, -1)
-        # committed-block PartSets served to lagging peers, keyed by
-        # height (bounded LRU: peers lagging at different heights must
-        # not thrash a single-entry cache with full re-merkleizations)
+        # committed-block PartSets / commit-vote lists served to lagging
+        # peers, keyed by height (bounded LRU: peers lagging at different
+        # heights must not thrash a single-entry cache with full
+        # re-merkleizations, and one vote send must not rebuild the list)
         self._catchup_cache: dict[int, PartSet] = {}
+        self._catchup_votes: dict[int, tuple] = {}
         # height-keyed assembly of a known-valid block (catchup path):
         # headers arrive via NewValidBlock, parts verified against them.
         # Multiple candidates per height, bounded: a forged header from
@@ -508,8 +521,11 @@ class ConsensusReactor(Reactor):
         elif isinstance(msg, VoteSetBitsMessage):
             # the peer's bitmap for (height, round, type): every set bit
             # is a vote we need not gossip to it (reference peer_state
-            # ApplyVoteSetBitsMessage)
+            # ApplyVoteSetBitsMessage). Bounded: one crafted message must
+            # not force millions of marks.
             bits = msg.bits
+            if bits.bit_length() > MAX_VALIDATORS:
+                return
             i = 0
             while bits:
                 if bits & 1:
@@ -685,14 +701,19 @@ class ConsensusReactor(Reactor):
         if h < cs.height:
             if self.block_store is None:
                 return False
-            blk = self.block_store.load_block(h)
-            if blk is None:
-                return False
             with self._lock:
                 cps = self._catchup_cache.get(h)
-                if cps is None:
-                    cps = PartSet.from_data(blk.encode())
-                    self._catchup_cache[h] = cps
+            if cps is None:
+                # one store load + encode + merkleization per height, NOT
+                # per part: the cache is consulted before touching the
+                # store (a 32-part block would otherwise decode 32 times
+                # per lagging peer)
+                blk = self.block_store.load_block(h)
+                if blk is None:
+                    return False
+                cps = PartSet.from_data(blk.encode())
+                with self._lock:
+                    cps = self._catchup_cache.setdefault(h, cps)
                     while len(self._catchup_cache) > self.CATCHUP_CACHE_SIZE:
                         self._catchup_cache.pop(
                             next(iter(self._catchup_cache))
@@ -782,7 +803,13 @@ class ConsensusReactor(Reactor):
 
     def _commit_as_voteset(self, height: int):
         """Stored commit -> precommit votes for catchup gossip (reference
-        gossipVotesRoutine LoadCommit path)."""
+        gossipVotesRoutine LoadCommit path). Cached per height beside the
+        catchup PartSets: one vote is sent per gossip iteration and the
+        reconstruction must not repeat per vote."""
+        with self._lock:
+            cached = self._catchup_votes.get(height)
+        if cached is not None:
+            return cached
         store = self.block_store
         if store is None:
             return None
@@ -807,7 +834,12 @@ class ConsensusReactor(Reactor):
                     signature=csig.signature,
                 )
             )
-        return commit.round, votes
+        out = (commit.round, votes)
+        with self._lock:
+            self._catchup_votes[height] = out
+            while len(self._catchup_votes) > self.CATCHUP_CACHE_SIZE:
+                self._catchup_votes.pop(next(iter(self._catchup_votes)))
+        return out
 
     def _gossip_votes(self, ps: PeerState) -> bool:
         cs = self.cs

@@ -134,8 +134,11 @@ class GrpcRPCServer:
     def _get_block_results(self, req: bytes) -> bytes:
         d = pb.fields_to_dict(req)
         h = pb.to_i64(d.get(1, 0)) or self.block_store.height()
+        # the full stored FinalizeBlockResponse (tx results, validator
+        # updates, app hash) — not the 32-byte results hash the header
+        # commits to, which lives in load_finalize_response
         raw = (
-            self.state_store.load_finalize_response(h)
+            self.state_store.load_abci_responses(h)
             if self.state_store is not None else None
         )
         return pb.f_varint(1, h) + pb.f_bytes(2, raw or b"")

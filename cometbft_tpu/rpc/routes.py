@@ -215,8 +215,35 @@ def commit(env, params):
 
 def block_results(env, params):
     h = _get_height(env, params)
-    raw = env.state_store.load_finalize_response(h) if env.state_store else None
-    return {"height": str(h), "results_hash": _hx(raw or b"")}
+    if env.state_store is None:
+        raise RPCError(-32603, "state store unavailable")
+    rhash = env.state_store.load_finalize_response(h)
+    out = {"height": str(h), "results_hash": _hx(rhash or b"")}
+    raw = env.state_store.load_abci_responses(h)
+    if raw:
+        from ..abci import wire as W
+
+        resp = W.dec_finalize_resp(raw)
+        out["txs_results"] = [
+            {
+                "code": tr.code,
+                "data": _hx(tr.data),
+                "log": tr.log,
+                "gas_wanted": str(tr.gas_wanted),
+                "gas_used": str(tr.gas_used),
+            }
+            for tr in resp.tx_results
+        ]
+        out["validator_updates"] = [
+            {
+                "pub_key": _hx(vu.pub_key_bytes),
+                "pub_key_type": vu.pub_key_type,
+                "power": str(vu.power),
+            }
+            for vu in resp.validator_updates
+        ]
+        out["app_hash"] = _hx(resp.app_hash)
+    return out
 
 
 def validators(env, params):
@@ -435,7 +462,7 @@ def genesis_chunked(env, params):
     }
 
 
-def _dial(env, params, mark_persistent):
+def _dial(env, params):
     if env.switch is None:
         raise RPCError(-32603, "p2p switch unavailable")
     peers = params.get("peers") or params.get("seeds") or []
@@ -451,11 +478,13 @@ def _dial(env, params, mark_persistent):
 
 
 def unsafe_dial_seeds(env, params):
-    return _dial(env, params, mark_persistent=False)
+    return _dial(env, params)
 
 
 def unsafe_dial_peers(env, params):
-    return _dial(env, params, mark_persistent=bool(params.get("persistent")))
+    # the reference's `persistent` flag is not supported: this switch
+    # has no redial list, so accepting the flag would silently lie
+    return _dial(env, params)
 
 
 unsafe_dial_peers.__doc__ = unsafe_dial_seeds.__doc__ = (
