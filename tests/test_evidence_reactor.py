@@ -105,7 +105,7 @@ def test_equivocation_gossips_and_commits(tmp_path):
             return v
 
         found_on = set()
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 150
         injected_at = 0
         while time.monotonic() < deadline and len(found_on) < 2:
             h = n0.consensus.height

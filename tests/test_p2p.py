@@ -157,7 +157,7 @@ def test_switch_dial_and_broadcast():
         peer = sw2.dial_peer(host, int(port))
         assert peer.id == t1.node_info.node_id
         # wait for sw1 to register the inbound peer
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while not sw1.peers() and time.monotonic() < deadline:
             time.sleep(0.02)
         assert len(sw1.peers()) == 1
@@ -253,12 +253,12 @@ def test_pex_gossip_and_dial(tmp_path):
         )
         # C dials B; pex request/response should teach C about A
         sw_c.dial_peer(host_b, int(port_b))
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while not book_c.has(t_a.node_info.node_id) and time.monotonic() < deadline:
             time.sleep(0.05)
         assert book_c.has(t_a.node_info.node_id), "C never learned A via PEX"
         pex_c.ensure_peers()
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while len(sw_c.peers()) < 2 and time.monotonic() < deadline:
             time.sleep(0.05)
         assert any(p.id == t_a.node_info.node_id for p in sw_c.peers())
