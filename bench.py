@@ -34,7 +34,8 @@ import time
 CPU_BASELINE_SIGS_PER_SEC = 1.0e6
 N_SIGS = 10_000
 N_COMMITS = 16  # pipeline depth (amortizes the fixed D2H round trip)
-N_ROUNDS = 5
+N_ROUNDS = 6
+ROUND_GAP_S = 8  # tunnel weather varies minute-to-minute: sample it
 
 
 def main():
@@ -68,7 +69,9 @@ def main():
     assert all(ok for ok, _ in res), "bench warmup must verify"
 
     best = 0.0
-    for _ in range(N_ROUNDS):
+    for r in range(N_ROUNDS):
+        if r:
+            time.sleep(ROUND_GAP_S)
         t0 = time.perf_counter()
         pending = [verifiers[i].submit() for i in range(N_COMMITS)]
         results = collect_pending(pending)
