@@ -112,8 +112,18 @@ def test_equivocation_gossips_and_commits(tmp_path):
             r = n0.consensus.round
             if h != injected_at:
                 injected_at = h
-                n0.consensus.send(VoteMessage(forge(h, r, 0xAA)), peer_id="byz")
-                n0.consensus.send(VoteMessage(forge(h, r, 0xBB)), peer_id="byz")
+                # inject for the current AND next height: under load the
+                # state machine may advance before it drains these from
+                # its queue, and stale-height votes are dropped without
+                # conflict detection
+                for hh_f in (h, h + 1):
+                    rr = r if hh_f == h else 0
+                    n0.consensus.send(
+                        VoteMessage(forge(hh_f, rr, 0xAA)), peer_id="byz"
+                    )
+                    n0.consensus.send(
+                        VoteMessage(forge(hh_f, rr, 0xBB)), peer_id="byz"
+                    )
             for i, node in enumerate((n0, n1)):
                 if i in found_on:
                     continue
