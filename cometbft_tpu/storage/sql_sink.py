@@ -1,0 +1,133 @@
+"""Relational event sink (reference internal/state/indexer/sink/psql).
+
+The reference's psql sink writes blocks, tx results, and their events
+into relational tables so operators can query with plain SQL instead of
+the node's query language. This is that sink over sqlite (the database
+engine this framework ships with; the schema matches the reference's
+blocks / tx_results / events / attributes layout, so pointing it at
+postgres later is a connection-string change, not a redesign).
+
+Wire it like the KV indexers: EventSinkService subscribes to the event
+bus and feeds the sink; or call index_block/index_tx directly (the CLI's
+reindex-event can target it too).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+    rowid INTEGER PRIMARY KEY,
+    height BIGINT NOT NULL,
+    chain_id TEXT NOT NULL,
+    created_at TEXT NOT NULL DEFAULT (datetime('now')),
+    UNIQUE (height, chain_id)
+);
+CREATE TABLE IF NOT EXISTS tx_results (
+    rowid INTEGER PRIMARY KEY,
+    block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+    index_in_block INTEGER NOT NULL,
+    created_at TEXT NOT NULL DEFAULT (datetime('now')),
+    tx_hash TEXT NOT NULL,
+    tx_result BLOB NOT NULL,
+    UNIQUE (block_id, index_in_block)
+);
+CREATE TABLE IF NOT EXISTS events (
+    rowid INTEGER PRIMARY KEY,
+    block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+    tx_id BIGINT REFERENCES tx_results(rowid),
+    type TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+    event_id BIGINT NOT NULL REFERENCES events(rowid),
+    key TEXT NOT NULL,
+    composite_key TEXT NOT NULL,
+    value TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_attributes_composite
+    ON attributes (composite_key, value);
+"""
+
+
+class SQLSink:
+    def __init__(self, path: str = ":memory:", chain_id: str = ""):
+        self.chain_id = chain_id
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    # ------------------------------------------------------------------
+    def _insert_events(self, cur, block_rowid, tx_rowid, events: dict):
+        """events: composite "type.key" -> [values] (the bus's shape)."""
+        by_type: dict[str, list[tuple[str, str, str]]] = {}
+        for composite, values in (events or {}).items():
+            etype, _, key = composite.rpartition(".")
+            if not etype:
+                etype = composite
+            for v in values:
+                by_type.setdefault(etype, []).append((key, composite, v))
+        for etype, attrs in by_type.items():
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) VALUES (?, ?, ?)",
+                (block_rowid, tx_rowid, etype),
+            )
+            eid = cur.lastrowid
+            cur.executemany(
+                "INSERT INTO attributes (event_id, key, composite_key, value)"
+                " VALUES (?, ?, ?, ?)",
+                [(eid, k, ck, v) for k, ck, v in attrs],
+            )
+
+    def index_block(self, height: int, events: dict | None = None) -> None:
+        with self._lock:
+            cur = self._db.cursor()
+            cur.execute(
+                "INSERT OR IGNORE INTO blocks (height, chain_id)"
+                " VALUES (?, ?)",
+                (height, self.chain_id),
+            )
+            cur.execute(
+                "SELECT rowid FROM blocks WHERE height=? AND chain_id=?",
+                (height, self.chain_id),
+            )
+            block_rowid = cur.fetchone()[0]
+            self._insert_events(cur, block_rowid, None, events or {})
+            self._db.commit()
+
+    def index_tx(self, height: int, index: int, tx_hash: bytes,
+                 tx_result: bytes, events: dict | None = None) -> None:
+        with self._lock:
+            cur = self._db.cursor()
+            cur.execute(
+                "INSERT OR IGNORE INTO blocks (height, chain_id)"
+                " VALUES (?, ?)",
+                (height, self.chain_id),
+            )
+            cur.execute(
+                "SELECT rowid FROM blocks WHERE height=? AND chain_id=?",
+                (height, self.chain_id),
+            )
+            block_rowid = cur.fetchone()[0]
+            cur.execute(
+                "INSERT OR REPLACE INTO tx_results"
+                " (block_id, index_in_block, tx_hash, tx_result)"
+                " VALUES (?, ?, ?, ?)",
+                (block_rowid, index, tx_hash.hex().upper(), tx_result),
+            )
+            tx_rowid = cur.lastrowid
+            self._insert_events(cur, block_rowid, tx_rowid, events or {})
+            self._db.commit()
+
+    # ------------------------------------------------------------------
+    def query(self, sql: str, params: tuple = ()) -> list[tuple]:
+        """Read-only SQL access (the sink's whole point)."""
+        with self._lock:
+            return list(self._db.execute(sql, params))
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

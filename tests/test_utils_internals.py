@@ -139,3 +139,40 @@ def test_amino_json_keys_roundtrip():
         assert "PrivKey" in pd["type"]
         pback = priv_key_from_json(pd)
         assert pback.pub_key().bytes() == pub.bytes()
+
+
+def test_sql_sink_blocks_txs_events(tmp_path):
+    """Relational event sink: blocks/tx_results/events/attributes rows
+    queryable with plain SQL (reference indexer/sink/psql)."""
+    from cometbft_tpu.storage.sql_sink import SQLSink
+
+    sink = SQLSink(str(tmp_path / "events.db"), chain_id="sink-chain")
+    sink.index_block(1, {"tm.event": ["NewBlock"], "block.height": ["1"]})
+    sink.index_tx(
+        1, 0, b"\xab" * 32, b"result-bytes",
+        {"tm.event": ["Tx"], "transfer.amount": ["17"],
+         "transfer.to": ["addr1"]},
+    )
+    sink.index_tx(
+        2, 0, b"\xcd" * 32, b"r2",
+        {"tm.event": ["Tx"], "transfer.amount": ["99"]},
+    )
+    # cross-table SQL: which heights saw a transfer over 50?
+    rows = sink.query(
+        "SELECT b.height FROM attributes a"
+        " JOIN events e ON a.event_id = e.rowid"
+        " JOIN blocks b ON e.block_id = b.rowid"
+        " WHERE a.composite_key = 'transfer.amount'"
+        " AND CAST(a.value AS INTEGER) > 50"
+    )
+    assert rows == [(2,)]
+    # tx lookup by hash
+    rows = sink.query(
+        "SELECT tx_result FROM tx_results WHERE tx_hash = ?",
+        ((b"\xab" * 32).hex().upper(),),
+    )
+    assert rows == [(b"result-bytes",)]
+    # idempotent block insert
+    sink.index_block(1)
+    assert sink.query("SELECT COUNT(*) FROM blocks") == [(2,)]
+    sink.close()
