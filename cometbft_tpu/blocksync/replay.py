@@ -75,9 +75,44 @@ class ReplayEngine:
             c = self.store.load_seen_commit(height)
         return c
 
-    def _light_check_window(self, state, blocks: list) -> int:
+    def _queue_window(self, chain_id, validators, lc_vals, prev_bid,
+                      initial_height, blocks: list):
+        """Submit (without blocking) every signature check a window of
+        blocks needs; returns an opaque handle for _resolve_window.
+
+        Split from the old synchronous check so run() can keep the
+        device verifying window w+1 while the host applies window w —
+        the replay loop is control-plane-bound (ABCI + stores + proto),
+        and serializing host and device work wastes whichever is
+        cheaper (VERDICT r3: verification was ~2 ms of a ~10 ms block
+        budget)."""
+        return self._window_batch(
+            chain_id, validators, lc_vals, prev_bid, initial_height, blocks
+        )
+
+    def _resolve_window(self, handle) -> int:
+        """Block on the device verdict; raise on any invalid signature
+        or insufficient tally. Returns signatures verified."""
+        pending, per_commit, nsigs = handle
+        ok, bits = pending.result()
+        if not ok:
+            for i, b in enumerate(bits):
+                if not b:
+                    raise ErrInvalidSignature(
+                        f"invalid signature in window lane {i}"
+                    )
+        for h, threshold, entries in per_commit:
+            tally = sum(entries)
+            if tally <= threshold:
+                raise ErrNotEnoughVotingPower(
+                    f"height {h}: tallied {tally} <= {threshold}"
+                )
+        return nsigs
+
+    def _window_batch(self, chain_id, validators, lc_vals_first, prev_bid,
+                      initial_height, blocks: list):
         """Batch every signature check the per-block path would do across a
-        window of blocks, in one device call.
+        window of blocks, submitted (not resolved) in one device call.
 
         Two families of commits go into the mega-batch:
 
@@ -92,8 +127,10 @@ class ReplayEngine:
            needs an external +2/3 endorsement since no successor block in
            this window embeds one).
 
-        Returns number of signatures verified. Raises CommitError on any
-        invalid signature, block-id mismatch, or insufficient tally.
+        The window only spans heights whose header.validators_hash equals
+        validators.hash() (caller enforces), so every embedded LastCommit
+        except the first block's was signed by `validators`; the first
+        block's was signed by `lc_vals_first`.
         """
         from ..types.validation import _check_commit_basics, ErrInvalidCommitSize
 
@@ -118,7 +155,7 @@ class ReplayEngine:
                     raise ErrInvalidSignature(
                         f"address mismatch at height {height} index {idx}"
                     )
-                msg = commit.vote_sign_bytes(state.chain_id, idx)
+                msg = commit.vote_sign_bytes(chain_id, idx)
                 before = bv.count()
                 bv.add(val.pub_key, msg, cs.signature)
                 if bv.count() == before:
@@ -137,84 +174,135 @@ class ReplayEngine:
                 (height, vals.total_voting_power() * 2 // 3, entries)
             )
 
-        # The window only spans heights whose header.validators_hash equals
-        # state.validators.hash() (caller enforces), so every embedded
-        # LastCommit except the first block's was signed by state.validators;
-        # the first block's was signed by state.last_validators.
-        prev_bid = state.last_block_id
-        lc_vals = state.last_validators
+        lc_vals = lc_vals_first
         for blk in blocks:
             h = blk.header.height
-            if h != state.initial_height:
+            if h != initial_height:
                 if lc_vals is None:
                     raise BlockValidationError(
                         f"no validator set for last commit of height {h}"
                     )
                 queue_commit(blk.last_commit, lc_vals, prev_bid, h - 1, all_sigs=True)
             prev_bid = block_id_for(blk)
-            lc_vals = state.validators
+            lc_vals = validators
         tip = blocks[-1].header.height
         commit = self._commit_for(tip)
         if commit is None:
             raise BlockValidationError(f"missing commit at height {tip}")
-        queue_commit(commit, state.validators, prev_bid, tip, all_sigs=False)
+        queue_commit(commit, validators, prev_bid, tip, all_sigs=False)
+        return bv.submit(), per_commit, lane + singles
 
-        ok, bits = bv.verify()
-        if not ok:
-            for i, b in enumerate(bits):
-                if not b:
-                    raise ErrInvalidSignature(f"invalid signature in window lane {i}")
-        for h, threshold, entries in per_commit:
-            tally = sum(entries)
-            if tally <= threshold:
-                raise ErrNotEnoughVotingPower(
-                    f"height {h}: tallied {tally} <= {threshold}"
-                )
-        return lane + singles
+    def _light_check_window(self, state, blocks: list) -> int:
+        """Synchronous window check (submit + resolve); kept for callers
+        outside the pipelined run loop."""
+        handle = self._queue_window(
+            state.chain_id, state.validators, state.last_validators,
+            state.last_block_id, state.initial_height, blocks,
+        )
+        return self._resolve_window(handle)
+
+    def _load_window(self, h: int, tip: int, vals_hash: bytes) -> list:
+        """Blocks [h .. h+window-1] bounded by tip and by the first
+        validator-set change (empty list when block h is stored but
+        belongs to a different set; raises when block h is missing)."""
+        w_end = min(h + self.window - 1, tip)
+        blocks = []
+        for hh in range(h, w_end + 1):
+            blk = self.store.load_block(hh)
+            if blk is None:
+                if hh == h:
+                    raise BlockValidationError(f"missing block at height {h}")
+                break
+            if blk.header.validators_hash != vals_hash:
+                break
+            blocks.append(blk)
+        return blocks
 
     def run(self, state, to_height: int | None = None) -> tuple[object, ReplayStats]:
-        """Replay from state.last_block_height+1 to `to_height` (or tip)."""
+        """Replay from state.last_block_height+1 to `to_height` (or tip).
+
+        Batched mode pipelines depth-2: window w+1's signature batch is
+        on the device while the host applies window w's blocks (sound
+        within a constant-validator-set span: w+1's verification inputs
+        — validator set and predecessor block id — are known before w is
+        applied; across a set change the pipeline drains and re-queues
+        with the post-apply state)."""
         stats = ReplayStats()
         t0 = time.perf_counter()
         tip = to_height or self.store.height()
         h = state.last_block_height + 1
-        while h <= tip:
-            if self.verify_mode == "batched":
-                # window must not cross a validator-set change; detect by
-                # comparing the stored blocks' validators_hash
-                w_end = min(h + self.window - 1, tip)
-                cur_hash = state.validators.hash()
-                blocks = []
-                for hh in range(h, w_end + 1):
-                    blk = self.store.load_block(hh)
-                    if blk is None or blk.header.validators_hash != cur_hash:
-                        break
-                    blocks.append(blk)
-                if not blocks:
-                    raise BlockValidationError(f"cannot form window at height {h}")
-                stats.sigs_verified += self._light_check_window(state, blocks)
+        if self.verify_mode == "batched" and h <= tip:
+            cur_hash = state.validators.hash()
+            blocks = self._load_window(h, tip, cur_hash)
+            if not blocks:
+                raise BlockValidationError(f"cannot form window at height {h}")
+            handle = self._queue_window(
+                state.chain_id, state.validators, state.last_validators,
+                state.last_block_id, state.initial_height, blocks,
+            )
+            while blocks:
+                nh = blocks[-1].header.height + 1
+                nxt = nxt_handle = None
+                if nh <= tip:
+                    # speculative: problems in window w+1's data must not
+                    # abort before the already-verified window w applies
+                    # (they resurface in the serial re-queue below, after
+                    # w's progress is durable)
+                    try:
+                        nxt = self._load_window(nh, tip, cur_hash)
+                        if nxt:
+                            # same-set continuation: queue before applying
+                            nxt_handle = self._queue_window(
+                                state.chain_id, state.validators,
+                                state.validators, block_id_for(blocks[-1]),
+                                state.initial_height, nxt,
+                            )
+                    except CommitError:
+                        nxt = nxt_handle = None
+                    except BlockValidationError:
+                        nxt = nxt_handle = None
+                stats.sigs_verified += self._resolve_window(handle)
                 for block in blocks:
                     bid = block_id_for(block)
                     state = self.executor.apply_block_preverified(state, bid, block)
                     stats.blocks += 1
-                h = blocks[-1].header.height + 1
-            else:
-                block = self.store.load_block(h)
-                commit = self._commit_for(h)
-                if block is None or commit is None:
-                    raise BlockValidationError(f"missing block/commit at {h}")
-                from ..types.validation import verify_commit_light
+                if nh > tip:
+                    break
+                if nxt_handle is None:
+                    # validator set changed at the boundary: reload and
+                    # queue against the post-apply state
+                    cur_hash = state.validators.hash()
+                    nxt = self._load_window(nh, tip, cur_hash)
+                    if not nxt:
+                        raise BlockValidationError(
+                            f"cannot form window at height {nh}"
+                        )
+                    nxt_handle = self._queue_window(
+                        state.chain_id, state.validators,
+                        state.last_validators, state.last_block_id,
+                        state.initial_height, nxt,
+                    )
+                blocks, handle = nxt, nxt_handle
+            stats.elapsed_s = time.perf_counter() - t0
+            return state, stats
+        # "full" mode: reference-faithful per-height verify + apply
+        from ..types.validation import verify_commit_light
 
-                bid = block_id_for(block)
-                verify_commit_light(
-                    state.chain_id, state.validators, bid, h, commit,
-                    backend=self.backend,
-                )
-                stats.sigs_verified += sum(
-                    1 for cs in commit.signatures if cs.is_commit()
-                )
-                state = self.executor.apply_block(state, bid, block)
-                stats.blocks += 1
-                h += 1
+        while h <= tip:
+            block = self.store.load_block(h)
+            commit = self._commit_for(h)
+            if block is None or commit is None:
+                raise BlockValidationError(f"missing block/commit at {h}")
+            bid = block_id_for(block)
+            verify_commit_light(
+                state.chain_id, state.validators, bid, h, commit,
+                backend=self.backend,
+            )
+            stats.sigs_verified += sum(
+                1 for cs in commit.signatures if cs.is_commit()
+            )
+            state = self.executor.apply_block(state, bid, block)
+            stats.blocks += 1
+            h += 1
         stats.elapsed_s = time.perf_counter() - t0
         return state, stats

@@ -46,6 +46,11 @@ class BaseConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     max_body_bytes: int = 1_000_000
+    # serve the unsafe_* operator routes (dial_seeds/dial_peers); off by
+    # default like the reference's rpc.unsafe flag (config/config.go) —
+    # anyone who can reach the listener could otherwise steer this
+    # node's peer connections (eclipse-attack aid)
+    unsafe: bool = False
     # gRPC services (reference [grpc] config): empty disables. The
     # privileged listener serves the pruning/data-companion API and
     # should stay on loopback.
@@ -86,6 +91,9 @@ class MempoolConfig:
     cache_size: int = 10000
     max_tx_bytes: int = 1_048_576
     keep_invalid_txs_in_cache: bool = False
+    # cap tx gossip fan-out per broadcast; 0 floods every peer
+    # (reference's experimental max-gossip-connections bound)
+    experimental_max_gossip_connections: int = 0
 
     def validate(self) -> None:
         if self.size <= 0 or self.cache_size <= 0:

@@ -14,11 +14,12 @@ Behavior parity: reference internal/consensus/state.go —
   last #ENDHEIGHT (reference internal/consensus/replay.go:94), with
   signing idempotence delegated to the FilePV last-sign state.
 
-Gossip transport differences (deliberate, host-side design): proposals
-carry the full block in a companion BlockBytesMessage over the loopback /
-p2p channel instead of 64 KiB parts. The part-set machinery still defines
-BlockID (types/part_set.py); part-wise gossip plugs into
-_handle_block_bytes's seam when the p2p reactor lands.
+Gossip transport: over real p2p the consensus reactor gossips proposals
+as 64 KiB merkle-proved parts (consensus/reactor.py, reference
+internal/consensus/reactor.go); the in-process loopback path used by
+tests can also deliver whole blocks via BlockBytesMessage through
+_handle_block_bytes. The part-set machinery defines BlockID either way
+(types/part_set.py).
 """
 
 from __future__ import annotations

@@ -40,20 +40,65 @@ SIG_SIZE = 64
 # it up to 16384 would waste 38% of lanes on the hottest batch shape.
 BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
 
-# At and above this size the RLC/MSM engine (ops/msm.py) would take over
-# from the per-lane bitmap kernel (one multi-scalar multiplication
+# At and above this size the RLC/MSM engine (ops/msm.py) is considered
+# instead of the per-lane ladder kernel (one multi-scalar multiplication
 # instead of N ladders, reference crypto/ed25519/ed25519.go:207-240).
-# Currently parked above any real batch: the MSM math costs ~2.2x fewer
-# field muls but its jnp composition pays per-op kernel-launch overhead
-# that the ladder's single fused pallas kernel does not — flipping this
-# on awaits the fused MSM accumulate kernel (ops/msm.py docstring).
-RLC_MIN = 1 << 30
+# The engines trade differently: RLC needs ~4x fewer device field muls
+# (Pippenger buckets vs per-lane ladders) but ships ~110 B/lane (R +
+# the digit stream) where the ladder ships 96 (R||S||k) — so on a
+# bandwidth-starved host->device link (this tunnel: 26-50 MB/s) the
+# ladder wins, while on a PCIe-class link RLC wins by ~3x. The dispatch
+# measures the link once (_link_mbps) and picks by modeled time.
+RLC_MIN = 4096
+_DEV_LADDER_US = 2.2   # measured device time per signature (PROFILE.md)
+_DEV_RLC_US = 0.7      # ~490 accumulate muls + decompress + reduce
+_WIRE_LADDER_B = 96    # R||S||k per lane
+# R (32) + A (32, re-shipped each submit: the RLC path keys its random
+# layout per batch, so there is no device-resident A cache analogue) +
+# ~39 digit-stream entries (~2.1 B) + counts
+_WIRE_RLC_B = 148
 
-# Below this size the native C++ RLC verifier wins: a commit-sized batch
+_LINK_MBPS: float | None = None
+
+
+def _link_mbps() -> float:
+    """One-time host->device bandwidth probe (2 MiB device_put). Drives
+    the ladder-vs-RLC dispatch; both paths are correct, this only picks
+    the faster one for the hardware at hand."""
+    global _LINK_MBPS
+    if _LINK_MBPS is None:
+        import time
+
+        import jax
+
+        buf = np.zeros(2 << 20, np.uint8)
+        jax.device_put(buf).block_until_ready()  # warm the path
+        t0 = time.perf_counter()
+        jax.device_put(buf).block_until_ready()
+        dt = max(time.perf_counter() - t0, 1e-6)
+        _LINK_MBPS = max(2.0 / dt, 1.0)
+    return _LINK_MBPS
+
+
+def _rlc_beats_ladder(n: int, b: int) -> bool:
+    bw = _link_mbps() * 1e6  # bytes/sec
+    t_ladder = max(_WIRE_LADDER_B * b / bw, n * _DEV_LADDER_US * 1e-6)
+    t_rlc = max(_WIRE_RLC_B * b / bw, n * _DEV_RLC_US * 1e-6)
+    return t_rlc < t_ladder
+
+
+# Below this size the native C++ verifier wins: a commit-sized batch
 # finishes in well under a TPU dispatch round trip (batch-size-aware
 # dispatch — reference types/validation.go:26-53 picks batch vs single
-# by support; we additionally pick the backend by size).
+# by support; we additionally pick the backend by size). The native
+# engine is the 8-lane AVX-512 IFMA Pippenger when the host supports
+# it (csrc/ed25519_ifma.inc), portable C++ otherwise.
 NATIVE_MAX = 1024
+
+# Minimum batch size for the structured-wire (delta) device path: below
+# this the detection overhead isn't worth it and the native engine has
+# already taken the batch anyway.
+DELTA_MIN = 256
 
 
 class Ed25519PubKey(PubKey):
@@ -142,6 +187,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         self.backend = backend
         self._force_perlane = force_perlane
         self._device_sha = device_sha
+        self._delta = None  # memoized message-structure detection
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
         if not isinstance(pub_key, Ed25519PubKey):
@@ -152,6 +198,7 @@ class Ed25519BatchVerifier(BatchVerifier):
             ok = s < ref.L  # non-canonical S rejected up front (ZIP-215 rule)
         self._items.append((pub_key.bytes(), msg, sig if ok else b"\x00" * 64))
         self._precheck_fail.append(not ok)
+        self._delta = None  # structure detection invalidated
         return ok
 
     def count(self) -> int:
@@ -185,7 +232,7 @@ class Ed25519BatchVerifier(BatchVerifier):
                 pending = self._native_batch()
                 if pending is not None:
                     return pending
-            if n >= RLC_MIN:
+            if n >= RLC_MIN and _rlc_beats_ladder(n, _bucket(n)):
                 pending = self._launch_rlc()
                 if pending is not None:
                     return pending
@@ -223,11 +270,14 @@ class Ed25519BatchVerifier(BatchVerifier):
 
     def _launch_rlc(self):
         """RLC/MSM path: one multi-scalar multiplication for the whole
-        batch; returns None when the host layout declines (bucket slot
-        overflow — vanishingly rare) so the per-lane kernel takes over."""
+        batch. The wire carries R plus the dense digit stream (~2 B per
+        contribution, ops/msm.py expand_stream rebuilds the gather table
+        on device). Returns None when the host layout declines (bucket
+        slot overflow — vanishingly rare) so the per-lane kernel takes
+        over."""
         import jax
 
-        from ..ops.msm import rlc_verify_jit
+        from ..ops.msm import rlc_verify_stream_jit
         from . import rlc as _rlc
 
         n = len(self._items)
@@ -248,18 +298,37 @@ class Ed25519BatchVerifier(BatchVerifier):
         a_bytes[:n] = pub_arr
         r_bytes[:n] = sig_arr[:, :32]
         live[:n] = ~skip
-        ok = rlc_verify_jit(
+        # pad the round count to a power of two (min 8): S is a static
+        # jit arg and the batch's max lane occupancy moves with the
+        # random z digits, so tiering keeps the compiled-variant count
+        # at ~2 per bucket instead of one per distinct occupancy
+        s_pad = 8
+        while s_pad < prep["s_rounds"]:
+            s_pad *= 2
+        global _LAST_WIRE_B_PER_LANE
+        _LAST_WIRE_B_PER_LANE = round(
+            (
+                32 * b  # R encodings
+                + prep["stream"].nbytes
+                + prep["stream_neg"].nbytes
+                + prep["counts"].nbytes
+            )
+            / b
+        )
+        ok = rlc_verify_stream_jit(
             *jax.device_put(
                 (
                     a_bytes,
                     r_bytes,
                     live,
-                    prep["gather_idx"],
-                    prep["gather_neg"],
+                    prep["stream"],
+                    prep["stream_neg"],
+                    prep["counts"],
                     prep["weights"],
                     prep["c_digits"],
                 )
-            )
+            ),
+            s_rounds=s_pad,
         )
         return PendingRLC(
             ok, n, list(self._precheck_fail), list(self._items)
@@ -289,6 +358,16 @@ class Ed25519BatchVerifier(BatchVerifier):
 
         n = len(self._items)
         b = _bucket(n)
+        # structured-message fast path: when the batch's messages share a
+        # common prefix + suffix (replay/commit sign bytes differ only in
+        # the vote timestamp), ship R||S + the per-lane delta and rebuild
+        # + hash the messages on device — fewer wire bytes per lane than
+        # the 96-byte R||S||k path on a bandwidth-limited link
+        if n >= DELTA_MIN:
+            if self._delta is None:
+                self._delta = _detect_delta(self._items) or False
+            if self._delta:
+                return self._launch_device_delta(self._delta)
         pub_blob = b"".join(it[0] for it in self._items)
         sig_arr = np.frombuffer(
             b"".join(it[2] for it in self._items), np.uint8
@@ -324,8 +403,79 @@ class Ed25519BatchVerifier(BatchVerifier):
             while len(_A_CACHE) > _A_CACHE_SIZE:
                 _A_CACHE.pop(next(iter(_A_CACHE)))
         ok_a, neg_a = cached
+        global _LAST_WIRE_B_PER_LANE
+        _LAST_WIRE_B_PER_LANE = _WIRE_LADDER_B
         return verify_batch_cached_a_jit(
             ok_a, neg_a, *jax.device_put((rsk, live))
+        )
+
+    def _launch_device_delta(self, d):
+        """Pack R||S + per-lane mid bytes; prefix/suffix/pubkey encodings
+        live on device (ops.ed25519_verify.verify_batch_delta)."""
+        import hashlib
+
+        import jax
+
+        from ..ops.ed25519_verify import (
+            decompress_pubkeys_jit,
+            verify_batch_delta_jit,
+        )
+
+        n = len(self._items)
+        b = _bucket(n)
+        self._oversize = []
+        pub_blob = b"".join(it[0] for it in self._items)
+        sig_arr = np.frombuffer(
+            b"".join(it[2] for it in self._items), np.uint8
+        ).reshape(n, 64)
+        midmax = d["midmax"]
+        rs_mid = np.zeros((b, 64 + midmax), np.uint8)
+        rs_mid[:n, :64] = sig_arr
+        lcp, lcs = d["lcp"], d["lcs"]
+        take = min(midmax, d["arr"].shape[1] - lcp)
+        if take > 0:
+            rs_mid[:n, 64 : 64 + take] = d["arr"][:, lcp : lcp + take]
+        mlens = np.zeros((b,), np.uint8)
+        mlens[:n] = d["mid_lens"]
+        live = np.zeros((b,), bool)
+        live[:n] = True
+        pmax = 176  # MAX_INPUT_BYTES - 64 rounded up; fixed jit shape
+        prefix = np.zeros((pmax,), np.uint8)
+        prefix[:lcp] = d["arr"][0, :lcp]
+        suffix = np.zeros((pmax,), np.uint8)
+        l0 = int(d["lens"][0])
+        suffix[:lcs] = d["arr"][0, l0 - lcs : l0]
+        # device-resident pubkey cache: decompressed points AND the raw
+        # encodings (the SHA preimage needs A's 32 bytes on device)
+        fp = (hashlib.sha256(pub_blob).digest(), b, "delta")
+        cached = _A_CACHE.get(fp)
+        if cached is None:
+            a_bytes = np.zeros((b, 32), np.uint8)
+            a_bytes[:n] = np.frombuffer(pub_blob, np.uint8).reshape(n, 32)
+            a_dev = jax.device_put(a_bytes)
+            ok_a, neg_a = decompress_pubkeys_jit(a_dev)
+            cached = (ok_a, neg_a, a_dev)
+            _A_CACHE[fp] = cached
+            while len(_A_CACHE) > _A_CACHE_SIZE:
+                _A_CACHE.pop(next(iter(_A_CACHE)))
+        ok_a, neg_a, a_dev = cached
+        global _LAST_WIRE_B_PER_LANE
+        _LAST_WIRE_B_PER_LANE = rs_mid.shape[1] + 1  # + mlens byte
+        return verify_batch_delta_jit(
+            ok_a,
+            neg_a,
+            a_dev,
+            *jax.device_put(
+                (
+                    rs_mid,
+                    mlens,
+                    np.int32(lcp),
+                    np.int32(lcs),
+                    prefix,
+                    suffix,
+                    live,
+                )
+            ),
         )
 
     def _launch_device_sha(self):
@@ -502,6 +652,56 @@ def collect_pending(pendings: list[PendingBatch]) -> list[tuple[bool, list[bool]
         return []
     summaries = np.asarray(jnp.stack([p._all_ok for p in pendings]))
     return [p._finalize_fast(bool(s)) for p, s in zip(pendings, summaries)]
+
+
+_LAST_WIRE_B_PER_LANE = _WIRE_LADDER_B  # introspection for bench/tools
+
+
+def _detect_delta(items):
+    """Longest-common-prefix/suffix structure detection over a batch's
+    messages (vectorized numpy). Commit/replay sign bytes differ per
+    lane only in the embedded vote timestamp, so most of the message is
+    shared; the device rebuilds it (ops.ed25519_verify.build_delta_msgs)
+    and only ~8-16 delta bytes cross the wire per lane. Returns the
+    packing dict, or None when the messages don't share enough structure
+    to beat the 96 B/lane host-hashed path."""
+    from ..ops.sha512 import MAX_INPUT_BYTES
+
+    msgs = [it[1] for it in items]
+    n = len(msgs)
+    if n == 0:
+        return None
+    lens = np.fromiter((len(m) for m in msgs), np.int64, n)
+    maxlen = int(lens.max())
+    minlen = int(lens.min())
+    if minlen == 0 or maxlen > MAX_INPUT_BYTES - 64:
+        return None
+    flat = np.frombuffer(b"".join(msgs), np.uint8)
+    off = np.concatenate([[0], np.cumsum(lens)])
+    idx = off[:-1, None] + np.arange(maxlen)[None, :]
+    arr = flat[np.clip(idx, 0, len(flat) - 1)] * (
+        np.arange(maxlen) < lens[:, None]
+    ).astype(np.uint8)
+    inrange = np.arange(maxlen) < minlen
+    common = (arr == arr[0:1]).all(axis=0) & inrange
+    lcp = minlen if common.all() else int(np.argmin(common))
+    ridx = off[1:, None] - 1 - np.arange(maxlen)[None, :]
+    rev = flat[np.clip(ridx, 0, len(flat) - 1)]
+    commons = (rev == rev[0:1]).all(axis=0) & inrange
+    lcs = minlen if commons.all() else int(np.argmin(commons))
+    lcs = min(lcs, minlen - lcp)
+    mid_lens = lens - lcp - lcs
+    midmax = max(8, -(-int(mid_lens.max()) // 8) * 8)
+    if 64 + midmax + 1 >= _WIRE_LADDER_B:
+        return None  # not enough shared structure to beat R||S||k
+    return {
+        "arr": arr,
+        "lens": lens,
+        "lcp": lcp,
+        "lcs": lcs,
+        "midmax": midmax,
+        "mid_lens": mid_lens,
+    }
 
 
 def batch_verifier(backend: str = "tpu") -> Ed25519BatchVerifier:

@@ -21,6 +21,9 @@ import threading
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc",
                     "ed25519_native.cpp")
+# sources whose edits must trigger a rebuild (the .cpp includes the
+# IFMA engine from the .inc)
+_SRC_DEPS = (_SRC, os.path.join(os.path.dirname(_SRC), "ed25519_ifma.inc"))
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
 _lock = threading.Lock()
@@ -48,9 +51,13 @@ def get_lib():
             return _lib
         _tried = True
         try:
+            src_mtime = max(
+                (os.path.getmtime(p) for p in _SRC_DEPS if os.path.exists(p)),
+                default=None,
+            )
             if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+                src_mtime is not None
+                and src_mtime > os.path.getmtime(_SO)
             ):
                 if not _build():
                     return None
@@ -73,8 +80,19 @@ def get_lib():
             ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
         ]
+        lib.ed25519_engine.restype = ctypes.c_int
+        lib.ed25519_engine.argtypes = []
         _lib = lib
         return _lib
+
+
+def engine() -> str:
+    """Which code path serves verification: "avx512-ifma" (the 8-lane
+    vpmadd52 engine) or "portable" (the scalar 5x51 engine)."""
+    lib = get_lib()
+    if lib is None:
+        return "unavailable"
+    return "avx512-ifma" if lib.ed25519_engine() else "portable"
 
 
 def available() -> bool:

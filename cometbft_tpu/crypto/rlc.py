@@ -44,19 +44,13 @@ def region_of_z(w: int) -> int:
     return 38 - 2 * w
 
 
-# Per-bucket-size slot depth: mean region occupancy is n/K_BUCKETS;
-# depth covers a +6 sigma Poisson tail so overflow (-> per-lane
-# fallback) is ~never.
-_SLOT_DEPTH = {
-    64: 6, 256: 8, 1024: 12, 4096: 28, 10240: 52, 16384: 70,
-}
-
-
+# Per-lane slot depth: long (window, digit) runs split across lanes at
+# this depth, which caps the device round count S = max lane occupancy.
+# The floor keeps Sigma_v ceil(count_v / depth) within the K-lane window
+# budget (risk of overflow -> per-lane fallback, ~never at +4 sigma).
 def slot_depth(bucket: int) -> int:
-    if bucket in _SLOT_DEPTH:
-        return _SLOT_DEPTH[bucket]
-    mean = bucket / K_BUCKETS
-    return int(mean + 6.0 * np.sqrt(mean) + 6)
+    mean = max(bucket / K_BUCKETS, 1.0)
+    return int(np.ceil(mean + 4.0 * np.sqrt(mean) + 4))
 
 
 def _signed_digits(scalars_bytes: np.ndarray, n_windows: int) -> np.ndarray:
@@ -90,6 +84,11 @@ def prepare(items, skip: np.ndarray, bucket: int):
     """
     n = len(items)
     depth = slot_depth(bucket)
+    if depth > 255:
+        # lane counts ship as uint8; buckets beyond 65536 would wrap
+        # them and corrupt the layout — decline so the per-lane kernel
+        # (which has no such bound) takes the batch
+        return None
 
     zs: list[int] = []
     ms: list[int] = []
@@ -182,18 +181,56 @@ def prepare(items, skip: np.ndarray, bucket: int):
         v0 = run_keys[r] % (K_BUCKETS + 1)
         weight_table[w0, run_base[r] : run_base[r] + run_lanes[r]] = v0
 
+    # ---- dense contribution stream ------------------------------------
+    # The naive (S, WK) gather table is mostly sentinel padding and costs
+    # hundreds of wire bytes per signature through a bandwidth-limited
+    # host->device link. Instead the host ships the contributions as ONE
+    # dense stream ordered by lane (index + sign) plus per-lane counts;
+    # the device reconstructs the (S, WK) gather table with an arange /
+    # cumsum gather (ops/msm.py expand_stream). Wire cost collapses to
+    # ~2 bytes per contribution (~= the digits' true entropy) instead of
+    # 5 bytes per (lane, slot) cell.
+    order2 = np.lexsort((slot, lane))  # by lane, then slot
+    lane_sorted = lane[order2]
+    counts = np.bincount(lane_sorted, minlength=WK).astype(np.uint8)
+    s_rounds = int(counts.max()) if len(lane_sorted) else 1
+    pt_sorted = pt_idx[order][order2].astype(np.int64)
+    neg_sorted = (dig[order][order2] < 0).astype(np.uint8)
     sentinel = 2 * bucket
-    gather_idx = np.full((WK, depth), sentinel, np.int32)
-    gather_neg = np.zeros((WK, depth), bool)
-    flat = lane * depth + slot
-    gather_idx.reshape(-1)[flat] = pt_idx[order]
-    gather_neg.reshape(-1)[flat] = dig[order] < 0
+    wide = sentinel > 0x7FFF  # uint16 covers buckets <= 16383
+    dt = np.uint32 if wide else np.uint16
+    stream = np.empty(len(pt_sorted) + 1, dt)
+    stream[:-1] = pt_sorted
+    stream[-1] = sentinel  # padding slots gather here (identity point)
+    # signs ride in a separate bit-packed array (the index may need the
+    # full 16 bits); one trailing 0 byte backs the padding slots
+    negbits = np.packbits(neg_sorted, bitorder="little")
+    stream_neg = np.zeros(len(negbits) + 1, np.uint8)
+    stream_neg[: len(negbits)] = negbits
 
     from ..ops.curve import scalar_digits
 
     return {
-        "gather_idx": np.ascontiguousarray(gather_idx.T),  # (S, WK)
-        "gather_neg": np.ascontiguousarray(gather_neg.T),
+        "stream": stream,  # (C+1,) point indices, dense by lane
+        "stream_neg": stream_neg,  # bit-packed signs, same order
+        "counts": counts,  # (WK,) contributions per lane
+        "s_rounds": s_rounds,  # device round count (static per launch)
         "weights": weight_table,  # (W, K) per-lane digit values
         "c_digits": scalar_digits([c]),  # (64, 1)
     }
+
+
+def expand_stream_host(prep, s_rounds: int | None = None):
+    """Numpy mirror of ops.msm.expand_stream: dense stream -> padded
+    (S, WK) gather table. Used by layout tests and debugging; the
+    production path expands on device so the wire stays compact."""
+    counts = prep["counts"].astype(np.int64)
+    S = s_rounds if s_rounds is not None else prep["s_rounds"]
+    offsets = np.cumsum(counts) - counts
+    pos = offsets[None, :] + np.arange(S)[:, None]
+    valid = np.arange(S)[:, None] < counts[None, :]
+    pos = np.where(valid, pos, len(prep["stream"]) - 1)
+    idx = prep["stream"][pos].astype(np.int64)
+    negbits = np.unpackbits(prep["stream_neg"], bitorder="little")
+    neg = (negbits[pos] != 0) & valid
+    return idx, neg

@@ -22,9 +22,19 @@ _U64 = 1 << 64
 _I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 
+# single-byte varints (the overwhelmingly common case: tags, small
+# lengths, flags) come from a table instead of the shift loop — varint
+# encoding is the hottest host function in replay profiles
+_UV1 = [bytes((i,)) for i in range(0x80)]
+
+
 def uvarint(v: int) -> bytes:
-    if v < 0:
-        raise ValueError("uvarint needs v >= 0")
+    if v < 0x80:
+        if v < 0:
+            raise ValueError("uvarint needs v >= 0")
+        return _UV1[v]
+    if v < 0x4000:
+        return bytes(((v & 0x7F) | 0x80, v >> 7))
     out = bytearray()
     while True:
         b = v & 0x7F

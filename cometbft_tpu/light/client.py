@@ -305,6 +305,16 @@ class LightClient:
                 dead.append(i)  # witness could not back its header
                 continue
             self._report_evidence(ev)
+            # Both directions matter (reference light/detector.go
+            # examines the primary's trace against the witness too):
+            # when the PRIMARY is the attacker, the witness's chain is
+            # canonical and full nodes on it would reject `ev` as
+            # non-conflicting — so also build evidence carrying the
+            # primary's forged block and hand it to the witness, whose
+            # chain can prosecute it.
+            ev_primary = self._evidence_for_block(lb, ev.common_height)
+            if ev_primary is not None:
+                self._report_evidence_to(w, ev_primary)
             raise ErrConflictingHeaders(i, lb.height, ev)
         for i in reversed(dead):
             self.witnesses.pop(i)
@@ -359,14 +369,40 @@ class LightClient:
             timestamp=common.signed_header.header.time,
         )
 
+    def _evidence_for_block(self, blk: LightBlock, common_height: int):
+        """LightClientAttackEvidence naming `blk` as the conflicting
+        block, rooted at the given trusted common height."""
+        from ..types.evidence import LightClientAttackEvidence
+
+        common = self.store.load(common_height)
+        if common is None:
+            return None
+        byz = []
+        for cs in blk.signed_header.commit.signatures:
+            if cs.is_absent():
+                continue
+            _, v = common.validators.get_by_address(cs.validator_address)
+            if v is not None:
+                byz.append(cs.validator_address)
+        return LightClientAttackEvidence(
+            conflicting_block=blk,
+            common_height=common.height,
+            byzantine_validators=byz,
+            total_voting_power=common.validators.total_voting_power(),
+            timestamp=common.signed_header.header.time,
+        )
+
+    def _report_evidence_to(self, provider, ev) -> None:
+        report = getattr(provider, "report_evidence", None)
+        if report is None:
+            return
+        try:
+            report(ev)
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+
     def _report_evidence(self, ev) -> None:
         """Hand the attack evidence to every provider that can accept it
         (reference light/detector.go sendEvidence)."""
         for p in [self.primary, *self.witnesses]:
-            report = getattr(p, "report_evidence", None)
-            if report is None:
-                continue
-            try:
-                report(ev)
-            except Exception:  # noqa: BLE001 — best-effort broadcast
-                continue
+            self._report_evidence_to(p, ev)

@@ -7,6 +7,8 @@ cache to stop loops)."""
 
 from __future__ import annotations
 
+import random
+
 from ..encoding import proto as pb
 from ..p2p.conn import ChannelDescriptor
 from ..p2p.switch import Reactor
@@ -15,9 +17,17 @@ MEMPOOL_CHANNEL = 0x30
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, mempool):
+    def __init__(self, mempool, max_gossip_peers: int = 0):
+        """max_gossip_peers > 0 caps tx fan-out to that many peers per
+        broadcast (the reference's experimental
+        max-gossip-connections-to-{persistent,non-persistent}-peers
+        bound, mempool/reactor.go): in dense topologies flooding every
+        peer mostly delivers duplicates, and the cap trades redundancy
+        for bandwidth. 0 = flood all peers (default, like the
+        reference)."""
         self.mempool = mempool
         self.switch = None
+        self.max_gossip_peers = max_gossip_peers
         mempool.on_new_tx.append(self._broadcast_tx)
 
     def channels(self) -> list[ChannelDescriptor]:
@@ -27,8 +37,22 @@ class MempoolReactor(Reactor):
         self.switch = switch
 
     def _broadcast_tx(self, tx: bytes) -> None:
-        if self.switch is not None:
-            self.switch.broadcast(MEMPOOL_CHANNEL, pb.f_bytes(1, tx, emit_empty=True))
+        if self.switch is None:
+            return
+        payload = pb.f_bytes(1, tx, emit_empty=True)
+        if self.max_gossip_peers <= 0:
+            self.switch.broadcast(MEMPOOL_CHANNEL, payload)
+            return
+        # sample a fresh subset per broadcast: a fixed prefix would
+        # permanently starve the peers beyond the cap
+        peers = list(self.switch.peers())
+        if len(peers) > self.max_gossip_peers:
+            peers = random.sample(peers, self.max_gossip_peers)
+        for peer in peers:
+            try:
+                peer.send(MEMPOOL_CHANNEL, payload)
+            except Exception:  # noqa: BLE001 — dead peer: skip
+                continue
 
     def receive(self, chan_id: int, peer, msg: bytes) -> None:
         d = pb.fields_to_dict(msg)

@@ -173,7 +173,12 @@ class Node:
         )
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.set_switch(self.switch)
-        self.mempool_reactor = MempoolReactor(self.mempool)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool,
+            max_gossip_peers=(
+                config.mempool.experimental_max_gossip_connections
+            ),
+        )
         self.mempool_reactor.set_switch(self.switch)
         from ..evidence.reactor import EvidenceReactor
 
@@ -255,7 +260,14 @@ class Node:
         rladdr = self.config.rpc.laddr
         if rladdr.startswith("tcp://"):
             rhost, rport = rladdr[len("tcp://"):].rsplit(":", 1)
-            self.rpc_server = RPCServer(self.rpc_env, rhost, int(rport))
+            routes = None
+            if self.config.rpc.unsafe:
+                from ..rpc.routes import ROUTES, UNSAFE_ROUTES
+
+                routes = {**ROUTES, **UNSAFE_ROUTES}
+            self.rpc_server = RPCServer(
+                self.rpc_env, rhost, int(rport), routes=routes
+            )
             self.rpc_server.start()
             self.rpc_addr = self.rpc_server.addr
         # gRPC services (reference rpc/grpc/server: a public listener and

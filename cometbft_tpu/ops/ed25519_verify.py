@@ -26,6 +26,19 @@ from . import scalar as SC
 from . import sha512 as H
 
 
+def _digest_to_bytes(hi, lo):
+    """(8, B) u32 big-endian word pairs -> (B, 64) digest bytes in
+    hashlib order (byte i weighs 256^i in k)."""
+    digest = []
+    for w in range(8):
+        for part in (hi, lo):
+            v = part[w].astype(jnp.int32)
+            digest.extend(
+                [(v >> 24) & 0xFF, (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF]
+            )
+    return jnp.stack(digest, axis=-1).astype(jnp.uint8)
+
+
 def verify_batch(a_bytes, r_bytes, s_bytes, msg_words, two_blocks, live):
     """Batched ZIP-215 verify, fully on device.
 
@@ -39,15 +52,7 @@ def verify_batch(a_bytes, r_bytes, s_bytes, msg_words, two_blocks, live):
     Returns (B,) bool validity bitmap.
     """
     hi, lo = H.sha512_two_blocks(msg_words, two_blocks)  # (8, B) u32, BE
-    # Digest byte i (hashlib order: big-endian words) weighs 256^i in k.
-    digest = []
-    for w in range(8):
-        for part in (hi, lo):
-            v = part[w].astype(jnp.int32)
-            digest.extend(
-                [(v >> 24) & 0xFF, (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF]
-            )
-    digest_bytes = jnp.stack(digest, axis=-1).astype(jnp.uint8)  # (B, 64)
+    digest_bytes = _digest_to_bytes(hi, lo)  # (B, 64)
 
     k = SC.reduce512(digest_bytes)  # (22, B) canonical < L
     k_digits = SC.recode_signed(k)
@@ -110,6 +115,100 @@ def decompress_pubkeys(a_bytes):
 
 
 decompress_pubkeys_jit = jax.jit(decompress_pubkeys)
+
+
+def build_delta_msgs(a_enc, rs_mid, mlens, plen, slen, prefix, suffix):
+    """Reconstruct the SHA-512-padded R||A||M blocks on device from a
+    shared prefix/suffix plus per-lane delta bytes.
+
+    Replay and commit verification hash messages that differ per lane
+    only in a small middle section (the vote timestamp): the canonical
+    sign-bytes prefix (type, height, round, block id) and suffix (chain
+    id) are commit-invariant (types/block.py vote_sign_bytes cache).
+    Shipping R||S plus the ~8-16 byte delta instead of a 32-byte
+    host-hashed challenge scalar cuts the per-lane wire cost below 80
+    bytes — on a bandwidth-limited host->device link that transfer is
+    the throughput ceiling (PROFILE.md).
+
+    a_enc:  (B, 32) uint8 pubkey encodings (device-resident cache).
+    rs_mid: (B, 64 + MIDMAX) uint8 — R || S || mid bytes.
+    mlens:  (B,) int32 — per-lane mid length.
+    plen, slen: int32 scalars — shared prefix/suffix lengths (dynamic;
+            the arrays are padded to a fixed max so jit keys only on
+            the MIDMAX/bucket shapes).
+    prefix, suffix: (PMAX,), (SMAX,) uint8 shared bytes.
+
+    Returns (B, 64) uint32 big-endian padded words + (B,) two_blocks.
+    """
+    nbytes = H.PADDED_BYTES
+    midmax = rs_mid.shape[1] - 64
+    pos = jnp.arange(nbytes, dtype=jnp.int32)  # (256,)
+    m_off = pos - 64
+    mlens = mlens.astype(jnp.int32)
+    total = plen + mlens + slen  # (B,) message length per lane
+    head = jnp.concatenate([rs_mid[:, :32], a_enc], axis=1)  # (B,64) R||A
+    head_b = jnp.take(head, jnp.clip(pos, 0, 63), axis=1).astype(jnp.int32)
+    pfx_b = jnp.take(
+        prefix, jnp.clip(m_off, 0, prefix.shape[0] - 1)
+    ).astype(jnp.int32)
+    mid_b = jnp.take(
+        rs_mid[:, 64:], jnp.clip(m_off - plen, 0, midmax - 1), axis=1
+    ).astype(jnp.int32)
+    sfx_idx = m_off[None, :] - plen - mlens[:, None]  # (B, 256)
+    sfx_b = jnp.take(
+        suffix, jnp.clip(sfx_idx, 0, suffix.shape[0] - 1)
+    ).astype(jnp.int32)
+    b = jnp.where(
+        m_off[None, :] < 0,
+        head_b,
+        jnp.where(
+            m_off[None, :] < plen,
+            pfx_b[None, :],
+            jnp.where(
+                m_off[None, :] < plen + mlens[:, None],
+                mid_b,
+                jnp.where(m_off[None, :] < total[:, None], sfx_b, 0),
+            ),
+        ),
+    )
+    # SHA-512 padding: 0x80 terminator + big-endian bit length at the
+    # end of the last block (single block iff 64+total <= 111)
+    b = jnp.where(pos[None, :] == 64 + total[:, None], 0x80, b)
+    two = (64 + total) > 111
+    blk = jnp.where(two, nbytes, nbytes // 2)
+    bits = (64 + total) * 8  # < 2^16: two length bytes suffice
+    b = jnp.where(pos[None, :] == blk[:, None] - 2, bits[:, None] >> 8, b)
+    b = jnp.where(pos[None, :] == blk[:, None] - 1, bits[:, None] & 0xFF, b)
+    words = (
+        b.reshape(b.shape[0], H.PADDED_WORDS, 4).astype(jnp.uint32)
+        @ jnp.asarray([1 << 24, 1 << 16, 1 << 8, 1], jnp.uint32)
+    )
+    return words, two
+
+
+def verify_batch_delta(ok_a, neg_a, a_enc, rs_mid, mlens, plen, slen,
+                       prefix, suffix, live):
+    """verify_batch with cached pubkeys AND device-side challenge
+    hashing over reconstructed messages (build_delta_msgs): the wire
+    carries R||S plus the per-lane delta only."""
+    words, two = build_delta_msgs(
+        a_enc, rs_mid, mlens, plen, slen, prefix, suffix
+    )
+    hi, lo = H.sha512_two_blocks(words, two)
+    digest_bytes = _digest_to_bytes(hi, lo)
+    k = SC.reduce512(digest_bytes)
+    k_digits = SC.recode_signed(k)
+    s_bytes = rs_mid[:, 32:64]
+    s_digits = SC.digits_from_bytes(s_bytes)
+    s_ok = SC.lt_l(s_bytes)
+    ok_r, r_pt = C.decompress(rs_mid[:, :32])
+    X, Y, Z = C.ladder_sub_mul8(s_digits, k_digits, neg_a, r_pt)
+    ok_eq = F.is_zero(X) & F.eq(Y, Z)
+    bits = ok_a & ok_r & ok_eq & s_ok & live
+    return bits, jnp.all(bits | ~live)
+
+
+verify_batch_delta_jit = jax.jit(verify_batch_delta)
 
 
 def verify_batch_cached_a(ok_a, neg_a, rsk, live):

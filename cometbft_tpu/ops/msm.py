@@ -351,6 +351,27 @@ def _window_combine(win_sums):
     return acc
 
 
+def expand_stream(stream, stream_neg, counts, s_rounds):
+    """Dense contribution stream -> (S, WK) gather table, on device.
+
+    The host ships ~2 bytes per contribution (crypto/rlc.py); the
+    padded per-round table the accumulate kernel wants is rebuilt here
+    with a cumsum + masked take, so the wire never carries sentinel
+    padding. stream: (C+1,) uint16/uint32, last entry = identity
+    sentinel; stream_neg: bit-packed signs (+1 pad byte); counts: (WK,).
+    """
+    counts = counts.astype(jnp.int32)
+    offsets = jnp.cumsum(counts) - counts  # exclusive prefix
+    pos = offsets[None, :] + jnp.arange(s_rounds, dtype=jnp.int32)[:, None]
+    valid = jnp.arange(s_rounds, dtype=jnp.int32)[:, None] < counts[None, :]
+    pos = jnp.where(valid, pos, stream.shape[0] - 1)
+    idx = jnp.take(stream, pos).astype(jnp.int32)
+    negb = jnp.take(stream_neg, pos >> 3).astype(jnp.int32)
+    neg = ((negb >> (pos & 7)) & 1) != 0
+    neg = neg & valid  # padding gathers the identity, sign irrelevant
+    return idx, neg
+
+
 def rlc_verify(a_bytes, r_bytes, live, gather_idx, gather_neg, weights,
                c_digits):
     """One-scalar RLC batch verification.
@@ -398,3 +419,20 @@ def rlc_verify(a_bytes, r_bytes, live, gather_idx, gather_neg, weights,
 
 
 rlc_verify_jit = jax.jit(rlc_verify)
+
+
+def rlc_verify_stream(a_bytes, r_bytes, live, stream, stream_neg, counts,
+                      weights, c_digits, *, s_rounds: int):
+    """rlc_verify over the compact wire format: the (S, WK) table is
+    expanded on device (expand_stream) from the dense contribution
+    stream, so the host->device link carries ~2 B/contribution."""
+    gather_idx, gather_neg = expand_stream(
+        stream, stream_neg, counts, s_rounds
+    )
+    return rlc_verify(a_bytes, r_bytes, live, gather_idx, gather_neg,
+                      weights, c_digits)
+
+
+rlc_verify_stream_jit = jax.jit(
+    rlc_verify_stream, static_argnames=("s_rounds",)
+)

@@ -177,7 +177,12 @@ class SignerClient:
         self.addr = self._lsock.getsockname()
         self._conn: socket.socket | None = None
         self._conn_ready = threading.Event()
-        self._lock = threading.Lock()  # one request in flight at a time
+        # _lock guards only the connection REFERENCE (accept loop swaps
+        # it); _req_lock serializes requests. Socket I/O happens outside
+        # _lock so a fresh dial-in can replace a hung connection instead
+        # of waiting out the full socket timeout behind it.
+        self._lock = threading.Lock()
+        self._req_lock = threading.Lock()  # one request in flight at a time
         self._stopped = threading.Event()
         self._pub_key = None
         self._accept_thread = threading.Thread(
@@ -221,13 +226,13 @@ class SignerClient:
         across a reconnect once."""
         deadline = time.monotonic() + self.timeout_s * 2
         last_err: Exception | None = None
-        while time.monotonic() < deadline:
-            if not self._conn_ready.wait(timeout=0.1):
-                continue
-            with self._lock:
-                conn = self._conn
+        with self._req_lock:
+            while time.monotonic() < deadline:
+                if not self._conn_ready.wait(timeout=0.1):
+                    continue
+                with self._lock:
+                    conn = self._conn
                 if conn is None:
-                    self._conn_ready.clear()
                     continue
                 try:
                     _send_msg(conn, payload)
@@ -239,9 +244,10 @@ class SignerClient:
                         conn.close()
                     except OSError:
                         pass
-                    if self._conn is conn:
-                        self._conn = None
-                        self._conn_ready.clear()
+                    with self._lock:
+                        if self._conn is conn:
+                            self._conn = None
+                            self._conn_ready.clear()
         raise ConnectionError(
             f"no signer response within {self.timeout_s * 2:.1f}s: {last_err}"
         )

@@ -7,6 +7,9 @@ Bytes are hex-encoded strings; blocks/commits are rendered structurally.
 
 from __future__ import annotations
 
+import base64
+
+from ..crypto import merkle
 from ..crypto.keys import tmhash
 from ..mempool.mempool import ErrMempoolFull, ErrTxInCache, ErrTxTooLarge
 
@@ -37,6 +40,10 @@ class Env:
         self.genesis_doc = genesis_doc
         self.app_conns = app_conns
         self.node_info = node_info
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
 
 
 def _hx(b: bytes | None) -> str:
@@ -379,47 +386,123 @@ def num_unconfirmed_txs(env, params):
     }
 
 
+def _as_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "t", "yes")
+
+
+def _paginate(items, params, order_key=None):
+    """page/per_page/order_by handling shared by the search routes
+    (reference rpc/core/tx.go TxSearch + rpc/core/env.go validatePage:
+    per_page defaults to 30 capped at 100; page is 1-based; out-of-range
+    pages are an error; order_by is "asc" (default) or "desc")."""
+    order = str(params.get("order_by", "asc") or "asc").lower()
+    if order not in ("asc", "desc"):
+        raise RPCError(-32602, f"invalid order_by {order!r}")
+    if order_key is not None:
+        items = sorted(items, key=order_key, reverse=(order == "desc"))
+    elif order == "desc":
+        items = list(reversed(items))
+    try:
+        per_page = min(max(int(params.get("per_page", 30)), 1), 100)
+        page = int(params.get("page", 1))
+    except (TypeError, ValueError):
+        raise RPCError(-32602, "page/per_page must be integers")
+    total = len(items)
+    pages = max((total + per_page - 1) // per_page, 1)
+    if page < 1 or page > pages:
+        raise RPCError(-32602, f"page {page} out of range [1, {pages}]")
+    lo = (page - 1) * per_page
+    return items[lo : lo + per_page], total
+
+
+def _tx_proof(env, height: int, index: int, _cache=None):
+    """Merkle inclusion proof of tx `index` in block `height`'s data
+    hash (reference types/tx.go:79 Txs.Proof). `_cache` (dict keyed by
+    height) lets tx_search build each block's tree once per page instead
+    of once per result."""
+    entry = _cache.get(height) if _cache is not None else None
+    if entry is None:
+        blk = env.block_store.load_block(height)
+        if blk is None:
+            return None
+        root, proofs = merkle.proofs_from_byte_slices(
+            [tmhash(t) for t in blk.data.txs]
+        )
+        entry = (blk.data.txs, root, proofs)
+        if _cache is not None:
+            _cache[height] = entry
+    txs, root, proofs = entry
+    if index >= len(proofs):
+        return None
+    p = proofs[index]
+    return {
+        "root_hash": _hx(root),
+        "data": _hx(txs[index]),
+        "proof": {
+            "total": str(p.total),
+            "index": str(p.index),
+            "leaf_hash": _b64(p.leaf_hash),
+            "aunts": [_b64(a) for a in p.aunts],
+        },
+    }
+
+
 def tx(env, params):
     h = bytes.fromhex(params["hash"])
     rec = env.tx_indexer.get(h) if env.tx_indexer else None
     if rec is None:
         raise RPCError(-32603, "tx not found")
-    return {
+    out = {
         "hash": _hx(h),
         "height": str(rec["height"]),
         "index": rec["index"],
         "tx_result": {"code": rec["code"], "data": _hx(rec["data"])},
         "tx": _hx(rec["tx"]),
     }
+    if _as_bool(params.get("prove", False)):
+        proof = _tx_proof(env, rec["height"], rec["index"])
+        if proof is not None:
+            out["proof"] = proof
+    return out
 
 
 def tx_search(env, params):
     query = params.get("query", "")
     recs = env.tx_indexer.search(query) if env.tx_indexer else []
-    return {
-        "txs": [
-            {
-                "hash": _hx(tmhash(r["tx"])),
-                "height": str(r["height"]),
-                "index": r["index"],
-                "tx_result": {"code": r["code"]},
-            }
-            for r in recs
-        ],
-        "total_count": str(len(recs)),
-    }
+    page, total = _paginate(
+        recs, params, order_key=lambda r: (r["height"], r["index"])
+    )
+    prove = _as_bool(params.get("prove", False))
+    txs = []
+    proof_cache: dict = {}
+    for r in page:
+        item = {
+            "hash": _hx(tmhash(r["tx"])),
+            "height": str(r["height"]),
+            "index": r["index"],
+            "tx_result": {"code": r["code"]},
+        }
+        if prove:
+            proof = _tx_proof(env, r["height"], r["index"], proof_cache)
+            if proof is not None:
+                item["proof"] = proof
+        txs.append(item)
+    return {"txs": txs, "total_count": str(total)}
 
 
 def block_search(env, params):
     query = params.get("query", "")
     heights = env.block_indexer.search(query) if env.block_indexer else []
+    page, total = _paginate(heights, params, order_key=lambda h: h)
     out = []
-    for h in heights:
+    for h in page:
         blk = env.block_store.load_block(h)
         if blk is not None:
             out.append({"block_id": {"hash": _hx(blk.hash())},
                         "block": _block_json(blk)})
-    return {"blocks": out, "total_count": str(len(out))}
+    return {"blocks": out, "total_count": str(total)}
 
 
 def broadcast_evidence(env, params):
@@ -493,13 +576,18 @@ unsafe_dial_peers.__doc__ = unsafe_dial_seeds.__doc__ = (
 )
 
 
+# unsafe operator routes, served only when rpc.unsafe is enabled
+# (reference rpc/core/routes.go AddUnsafeRoutes gated by config Unsafe)
+UNSAFE_ROUTES = {
+    "unsafe_dial_seeds": unsafe_dial_seeds,
+    "unsafe_dial_peers": unsafe_dial_peers,
+}
+
 ROUTES = {
     "health": health,
     "status": status,
     "broadcast_evidence": broadcast_evidence,
     "genesis_chunked": genesis_chunked,
-    "unsafe_dial_seeds": unsafe_dial_seeds,
-    "unsafe_dial_peers": unsafe_dial_peers,
     "abci_info": abci_info,
     "abci_query": abci_query,
     "block": block,

@@ -104,11 +104,26 @@ class SqliteKV(KVStore):
             self._conn.commit()
 
     def iterate_prefix(self, prefix):
-        hi = prefix + b"\xff" * 8
+        # upper bound = prefix with its last non-0xff byte incremented
+        # (exclusive): a suffix-based bound like prefix+b"\xff"*N would
+        # silently exclude keys extending further than N bytes
+        hi = None
+        p = bytearray(prefix)
+        for i in range(len(p) - 1, -1, -1):
+            if p[i] != 0xFF:
+                p[i] += 1
+                hi = bytes(p[: i + 1])
+                break
         with self._lock:
-            rows = self._conn.execute(
-                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k", (prefix, hi)
-            ).fetchall()
+            if hi is None:  # all-0xff (or empty) prefix: no upper bound
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (prefix,)
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                    (prefix, hi),
+                ).fetchall()
         for k, v in rows:
             if bytes(k).startswith(prefix):
                 yield bytes(k), bytes(v)

@@ -112,8 +112,28 @@ class SQLSink:
                 (height, self.chain_id),
             )
             block_rowid = cur.fetchone()[0]
+            # re-indexing the same (block, index): drop the old row's
+            # dependent events/attributes first — INSERT OR REPLACE
+            # assigns a fresh rowid, which would leave them dangling and
+            # duplicate event rows on every reindex
             cur.execute(
-                "INSERT OR REPLACE INTO tx_results"
+                "SELECT rowid FROM tx_results"
+                " WHERE block_id=? AND index_in_block=?",
+                (block_rowid, index),
+            )
+            old = cur.fetchone()
+            if old is not None:
+                cur.execute(
+                    "DELETE FROM attributes WHERE event_id IN"
+                    " (SELECT rowid FROM events WHERE tx_id=?)",
+                    (old[0],),
+                )
+                cur.execute("DELETE FROM events WHERE tx_id=?", (old[0],))
+                cur.execute(
+                    "DELETE FROM tx_results WHERE rowid=?", (old[0],)
+                )
+            cur.execute(
+                "INSERT INTO tx_results"
                 " (block_id, index_in_block, tx_hash, tx_result)"
                 " VALUES (?, ?, ?, ?)",
                 (block_rowid, index, tx_hash.hex().upper(), tx_result),
@@ -124,9 +144,22 @@ class SQLSink:
 
     # ------------------------------------------------------------------
     def query(self, sql: str, params: tuple = ()) -> list[tuple]:
-        """Read-only SQL access (the sink's whole point)."""
+        """Read-only SQL access (the sink's whole point). Writes are
+        rejected via the sqlite authorizer for the duration of the call
+        — operator dashboards get SELECT, not a mutation side door."""
+        def _authorize(action, *_):
+            if action in (sqlite3.SQLITE_SELECT, sqlite3.SQLITE_READ,
+                          sqlite3.SQLITE_FUNCTION,
+                          sqlite3.SQLITE_RECURSIVE):
+                return sqlite3.SQLITE_OK
+            return sqlite3.SQLITE_DENY
+
         with self._lock:
-            return list(self._db.execute(sql, params))
+            self._db.set_authorizer(_authorize)
+            try:
+                return list(self._db.execute(sql, params))
+            finally:
+                self._db.set_authorizer(None)
 
     def close(self) -> None:
         with self._lock:

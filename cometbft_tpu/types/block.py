@@ -196,7 +196,16 @@ class Commit:
     signatures: list[CommitSig] = field(default_factory=list)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([cs.encode() for cs in self.signatures])
+        # memoized: commits are immutable once decoded/sealed, and block
+        # validation re-merkles the predecessor's 100+ signatures per
+        # height otherwise
+        h = self.__dict__.get("_hash_memo")
+        if h is None:
+            h = merkle.hash_from_byte_slices(
+                [cs.encode() for cs in self.signatures]
+            )
+            self.__dict__["_hash_memo"] = h
+        return h
 
     def size(self) -> int:
         return len(self.signatures)
@@ -225,12 +234,21 @@ class Commit:
             )
             self.__dict__["_sb_cache"] = cache
         _, with_bid, nil_bid, tail = cache
-        prefix = (
-            with_bid if cs.block_id_flag == BlockIDFlag.COMMIT else nil_bid
-        )
-        return pb.length_prefixed(
+        is_commit = cs.block_id_flag == BlockIDFlag.COMMIT
+        key = (cache, is_commit, cs.timestamp)
+        sb = cs.__dict__.get("_sb")
+        if sb is not None and sb[0] == key and sb[0][0] is cache:
+            return sb[1]
+        prefix = with_bid if is_commit else nil_bid
+        out = pb.length_prefixed(
             prefix + pb.f_embedded(5, cs.timestamp.encode()) + tail
         )
+        # memo per CommitSig, keyed on the prefix-cache identity (which
+        # changes whenever chain_id/height/round/block_id change) plus
+        # the slot fields the bytes depend on: vote gossip and repeated
+        # commit verification rebuild these bytes many times
+        cs.__dict__["_sb"] = (key, out)
+        return out
 
     def encode(self) -> bytes:
         out = (
@@ -265,11 +283,21 @@ def tx_hash(tx: bytes) -> bytes:
 
 def block_id_for(block: "Block") -> BlockID:
     """Canonical BlockID: header hash + part-set header over the block bytes
-    (reference types/block.go MakePartSet + BlockID)."""
+    (reference types/block.go MakePartSet + BlockID).
+
+    Memoized per Block instance: callers compute the id of a COMPLETE
+    block (decoded from the store/wire or finalized by consensus), and
+    replay/validation would otherwise re-encode + re-merkle the same
+    ~10 KB block three times per height."""
+    memo = block.__dict__.get("_bid_memo")
+    if memo is not None:
+        return memo
     from .part_set import PartSet
 
     ps = PartSet.from_data(block.encode())
-    return BlockID(block.hash(), ps.header)
+    bid = BlockID(block.hash(), ps.header)
+    block.__dict__["_bid_memo"] = bid
+    return bid
 
 
 @dataclass

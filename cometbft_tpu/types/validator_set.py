@@ -149,9 +149,17 @@ class ValidatorSet:
         return self.get_by_address(addr)[0] >= 0
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
-            [v.simple_encode() for v in self.validators]
-        )
+        # memoized: the hash covers only (pubkey, power) — membership
+        # changes go through update_with_changeset (which invalidates);
+        # proposer-priority churn doesn't affect it. Replay hashes the
+        # same set once per block otherwise (~ms each at 100 vals).
+        h = self.__dict__.get("_hash_memo")
+        if h is None:
+            h = merkle.hash_from_byte_slices(
+                [v.simple_encode() for v in self.validators]
+            )
+            self.__dict__["_hash_memo"] = h
+        return h
 
     def copy(self) -> "ValidatorSet":
         vs = ValidatorSet.__new__(ValidatorSet)
@@ -159,6 +167,9 @@ class ValidatorSet:
         vs.proposer = self.proposer.copy() if self.proposer else None
         vs._total_power = self._total_power
         vs._addr_index = None
+        memo = self.__dict__.get("_hash_memo")
+        if memo is not None:  # same membership -> same hash
+            vs.__dict__["_hash_memo"] = memo
         return vs
 
     # --- proposer priority machinery ---
@@ -295,6 +306,7 @@ class ValidatorSet:
         self.validators = sorted(updated, key=_sort_key)
         self._total_power = None
         self._addr_index = None
+        self.__dict__.pop("_hash_memo", None)
         self.total_voting_power()
         # scale into the priority window, then center (reference order)
         self.rescale_priorities(

@@ -794,6 +794,26 @@ static void straus_sb_ka(P *o, const u8 s[32], const u8 k[32], const P *negA) {
 
 }  // namespace ge
 
+// ------------------------------------------- AVX-512 IFMA engine --------
+// 4-lane vectorized engine using vpmadd52{l,h}uq — the 52-bit
+// multiply-accumulate the instruction set grew for exactly this field.
+// Two lane disciplines share one type:
+//  - point ops: lanes = the 4 independent field muls inside the unified
+//    a=-1 Edwards addition (add-2008-hwcd-3): an add or double is TWO
+//    vector muls instead of eight serial ones;
+//  - decompression: lanes = 4 independent signatures through the
+//    identical sqrt-chain control flow.
+// Radix 2^52 (5 limbs, 260 bits): limb positions line up with the
+// 52-bit instruction split, and 2^260 === 608 (mod p) folds overflow.
+// Compiled only when -march=native enables IFMA (build-on-demand per
+// machine, cometbft_tpu/crypto/native.py), with a runtime cpuid check.
+#if defined(__AVX512IFMA__) && defined(__AVX512VL__) && defined(__AVX512DQ__)
+#define ED25519_HAVE_IFMA 1
+#include <immintrin.h>
+
+#include "ed25519_ifma.inc"
+#endif  // ED25519_HAVE_IFMA
+
 // Decoded-pubkey cache shared by single and batch verification: commit
 // verification re-checks the SAME validator set every height, so the
 // sqrt exponentiation per A — roughly a third of the single-verify cost
@@ -824,8 +844,20 @@ static bool cached_neg_decompress(ge::P *negA, const u8 pub[32]) {
 // ------------------------------------------------------- public ABI ------
 extern "C" {
 
+// which engine serves verification: 1 = AVX-512 IFMA vector engine,
+// 0 = portable scalar (tests/bench report this)
+int ed25519_engine(void) {
+#ifdef ED25519_HAVE_IFMA
+    if (v4::usable()) return 1;
+#endif
+    return 0;
+}
+
 // verify: ZIP-215. Returns 1 valid, 0 invalid.
 int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
+#ifdef ED25519_HAVE_IFMA
+    if (v4::usable()) return v4::verify_v4(pub, msg, msg_len, sig);
+#endif
     ge::init_constants();
     // S < L
     u64 s_words[4];
@@ -861,8 +893,25 @@ int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
 // gives each length.
 int ed25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
                          const u64 *msg_lens, const u8 *sigs) {
+#ifdef ED25519_HAVE_IFMA
+    if (v4::usable()) return v4::batch_verify_v4(n, pubs, msgs, msg_lens, sigs);
+#endif
     ge::init_constants();
     if (n == 0) return 0;
+    // z seed: OS entropy once per batch, expanded by counter hashing.
+    // Fail CLOSED without it: batch soundness rests on the z_i being
+    // unpredictable to the signer, and any input-derived fallback is
+    // attacker-influenced (fd exhaustion is attacker-reachable). A 0
+    // return sends the caller to per-signature verification, which
+    // needs no randomness. Read BEFORE the allocations so the failure
+    // path leaks nothing.
+    u8 seed[32];
+    {
+        FILE *f = fopen("/dev/urandom", "rb");
+        size_t got = f ? fread(seed, 1, 32, f) : 0;
+        if (f) fclose(f);
+        if (got != 32) return 0;
+    }
     const int ZW = 17, MW = 32, NW = 32;  // windows: z, z*h, Horner span
     ge::P *negR = new ge::P[n], *negA = new ge::P[n];
     signed char *zd = new signed char[n * ZW];
@@ -871,19 +920,6 @@ int ed25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
     {
         u64 off = 0;
         for (u64 i = 0; i < n; i++) { offsets[i] = off; off += msg_lens[i]; }
-    }
-    // z seed: OS entropy once per batch, expanded by counter hashing.
-    // Fail CLOSED without it: batch soundness rests on the z_i being
-    // unpredictable to the signer, and any input-derived fallback is
-    // attacker-influenced (fd exhaustion is attacker-reachable). A 0
-    // return sends the caller to per-signature verification, which
-    // needs no randomness.
-    u8 seed[32];
-    {
-        FILE *f = fopen("/dev/urandom", "rb");
-        size_t got = f ? fread(seed, 1, 32, f) : 0;
-        if (f) fclose(f);
-        if (got != 32) return 0;
     }
     unsigned nthreads = std::thread::hardware_concurrency();
     if (nthreads == 0) nthreads = 1;

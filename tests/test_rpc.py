@@ -186,3 +186,69 @@ def test_rpc_websocket_subscribe(rpc_node):
     evt = read_text()
     assert evt["result"]["data"]["type"] == "NewBlock"
     s.close()
+
+
+def test_rpc_tx_prove_and_pagination(rpc_node):
+    """tx?prove=true returns a verifying merkle inclusion proof, and the
+    search routes honor page/per_page/order_by (reference rpc/core/tx.go
+    + types/tx.go:79)."""
+    import base64
+
+    from cometbft_tpu.crypto.merkle import Proof
+    from cometbft_tpu.rpc import HTTPClient
+
+    host, port = rpc_node.rpc_addr
+    c = HTTPClient(f"http://{host}:{port}")
+    txs = [b"prove-%d=%d" % (i, i) for i in range(3)]
+    heights = []
+    res = None
+    for tx in txs:
+        res = c.broadcast_tx_commit(tx=tx.hex())
+        assert res["tx_result"]["code"] == 0
+        heights.append(int(res["height"]))
+    deadline = time.monotonic() + 10
+    rec = None
+    while time.monotonic() < deadline:
+        try:
+            rec = c.tx(hash=res["hash"].lower(), prove=True)
+            break
+        except RuntimeError:
+            time.sleep(0.1)
+    assert rec is not None and "proof" in rec, rec
+    pf = rec["proof"]
+    proof = Proof(
+        total=int(pf["proof"]["total"]),
+        index=int(pf["proof"]["index"]),
+        leaf_hash=base64.b64decode(pf["proof"]["leaf_hash"]),
+        aunts=[base64.b64decode(a) for a in pf["proof"]["aunts"]],
+    )
+    from cometbft_tpu.types.block import tx_hash
+
+    # proof leaves are tx hashes (reference types/tx.go Txs.Proof)
+    assert proof.verify(bytes.fromhex(pf["root_hash"]), tx_hash(txs[-1]))
+    # the proven root is the block's data hash
+    blk = c.block(height=str(heights[-1]))
+    assert (
+        blk["block"]["header"]["data_hash"].lower()
+        == pf["root_hash"].lower()
+    )
+
+    # pagination + ordering over everything indexed so far
+    all_res = c.tx_search(query=f"tx.height > 0", per_page=2, page=1)
+    total = int(all_res["total_count"])
+    assert total >= 3 and len(all_res["txs"]) == 2
+    asc = c.tx_search(query="tx.height > 0", per_page=100, order_by="asc")
+    desc = c.tx_search(query="tx.height > 0", per_page=100, order_by="desc")
+    ah = [int(t["height"]) for t in asc["txs"]]
+    dh = [int(t["height"]) for t in desc["txs"]]
+    assert ah == sorted(ah) and dh == sorted(dh, reverse=True)
+    # out-of-range page errors
+    try:
+        c.tx_search(query="tx.height > 0", per_page=2, page=9999)
+        raise AssertionError("expected out-of-range page error")
+    except RuntimeError:
+        pass
+    # block_search paginates too
+    bs = c.block_search(query="block.height >= 1", per_page=1, page=1,
+                        order_by="desc")
+    assert len(bs["blocks"]) == 1 and int(bs["total_count"]) >= 1
