@@ -58,9 +58,13 @@ class ReplayEngine:
         block_store: BlockStore,
         executor: BlockExecutor,
         verify_mode: str = "batched",
-        window: int = 32,
+        window: int = 64,
         backend: str = "tpu",
     ):
+        # window=64 default: each window resolve pays one device->host
+        # round trip (~100 ms on a tunneled runtime), so fewer, larger
+        # windows amortize it; 64 heights x 150 validators still fits
+        # the 16384-lane bucket
         if verify_mode not in ("full", "batched"):
             raise ValueError(f"unknown verify_mode {verify_mode}")
         self.store = block_store

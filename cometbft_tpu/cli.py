@@ -364,6 +364,31 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_bootstrap_state(args) -> int:
+    """reference `cometbft bootstrap-state` (node/node.go:150-259): seed
+    a fresh node's state store from light-client-verified state so it
+    block-syncs from there instead of replaying from genesis."""
+    from .config import Config
+    from .node.node import bootstrap_state
+
+    p = _cfg_paths(args.home)
+    cfg = Config.load(p["config_file"])
+    cfg.base.home = args.home
+    try:
+        h = bootstrap_state(
+            cfg,
+            height=args.height,
+            rpc_servers=args.servers,
+            trust_height=args.trust_height,
+            trust_hash=args.trust_hash,
+        )
+    except Exception as e:  # noqa: BLE001 — operator tool
+        print(f"bootstrap-state failed: {e}")
+        return 1
+    print(f"bootstrapped state at height {h}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -412,6 +437,15 @@ def main(argv=None) -> int:
     sp.add_argument("--hard", action="store_true",
                     help="also remove the pending block from the block store")
     sp.set_defaults(fn=cmd_rollback)
+    sp = sub.add_parser("bootstrap-state")
+    sp.add_argument("--height", type=int, default=0,
+                    help="state height to bootstrap (0 = latest - 2)")
+    sp.add_argument("--servers", default="",
+                    help="comma-separated RPC endpoints "
+                         "(default: statesync.rpc_servers)")
+    sp.add_argument("--trust-height", type=int, default=0)
+    sp.add_argument("--trust-hash", default="")
+    sp.set_defaults(fn=cmd_bootstrap_state)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = ap.parse_args(argv)

@@ -72,6 +72,10 @@ class P2PConfig:
     max_outbound_peers: int = 10
     send_rate: int = 512_000  # bytes/s (reference 500 KB/s default)
     recv_rate: int = 512_000
+    # arm the fault-injection control channel (data/partition.json ->
+    # transport-level peer blocking) — test harness only; a production
+    # node must not expose a file that silently isolates it
+    fault_injection: bool = False
 
     def validate(self) -> None:
         if self.max_inbound_peers < 0 or self.max_outbound_peers < 0:
@@ -155,6 +159,10 @@ class StateSyncConfig:
     discovery_time_s: float = 2.0
     chunk_fetchers: int = 4
     temp_dir: str = ""
+    # comma-separated RPC endpoints for light-client verification
+    # (reference statesync.rpc_servers); used by `bootstrap-state` and
+    # available to operators running statesync against known nodes
+    rpc_servers: str = ""
 
     def validate(self) -> None:
         if self.enable:

@@ -97,8 +97,13 @@ NATIVE_MAX = 1024
 
 # Minimum batch size for the structured-wire (delta) device path: below
 # this the detection overhead isn't worth it and the native engine has
-# already taken the batch anyway.
+# already taken the batch anyway. The upper bucket bound keeps the
+# on-device SHA + ladder graph at sizes whose XLA compile stays in the
+# tens-of-seconds class — at 65536 lanes the combined graph takes tens
+# of minutes to compile on a small host, dwarfing the ~23 B/lane wire
+# saving it buys (mega-batches use the prehashed 96-byte path instead).
 DELTA_MIN = 256
+DELTA_MAX_BUCKET = 16384
 
 
 class Ed25519PubKey(PubKey):
@@ -363,7 +368,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         # the vote timestamp), ship R||S + the per-lane delta and rebuild
         # + hash the messages on device — fewer wire bytes per lane than
         # the 96-byte R||S||k path on a bandwidth-limited link
-        if n >= DELTA_MIN:
+        if DELTA_MIN <= n and b <= DELTA_MAX_BUCKET:
             if self._delta is None:
                 self._delta = _detect_delta(self._items) or False
             if self._delta:
@@ -429,22 +434,31 @@ class Ed25519BatchVerifier(BatchVerifier):
             b"".join(it[2] for it in self._items), np.uint8
         ).reshape(n, 64)
         midmax = d["midmax"]
-        rs_mid = np.zeros((b, 64 + midmax), np.uint8)
-        rs_mid[:n, :64] = sig_arr
         lcp, lcs = d["lcp"], d["lcs"]
+        # one packed per-lane array + one tiny meta array: each
+        # device_put pays a fixed per-transfer cost on a tunneled
+        # runtime (same packing rationale as the 96-byte rsk array)
+        packed = np.zeros((b, 64 + midmax + 1), np.uint8)
+        packed[:n, :64] = sig_arr
         take = min(midmax, d["arr"].shape[1] - lcp)
         if take > 0:
-            rs_mid[:n, 64 : 64 + take] = d["arr"][:, lcp : lcp + take]
-        mlens = np.zeros((b,), np.uint8)
-        mlens[:n] = d["mid_lens"]
-        live = np.zeros((b,), bool)
-        live[:n] = True
-        pmax = 176  # MAX_INPUT_BYTES - 64 rounded up; fixed jit shape
-        prefix = np.zeros((pmax,), np.uint8)
-        prefix[:lcp] = d["arr"][0, :lcp]
-        suffix = np.zeros((pmax,), np.uint8)
+            packed[:n, 64 : 64 + take] = d["arr"][:, lcp : lcp + take]
+        packed[:n, -1] = d["mid_lens"]
+        from ..ops.ed25519_verify import (
+            DELTA_META_HEADER as _MH,
+            DELTA_META_LEN as _ML,
+            DELTA_PMAX as _PM,
+        )
+
+        meta = np.zeros((_ML,), np.uint8)
+        meta[0] = lcp
+        meta[1] = lcs
+        meta[2] = n & 0xFF
+        meta[3] = (n >> 8) & 0xFF
+        meta[4] = (n >> 16) & 0xFF
+        meta[_MH : _MH + lcp] = d["arr"][0, :lcp]
         l0 = int(d["lens"][0])
-        suffix[:lcs] = d["arr"][0, l0 - lcs : l0]
+        meta[_MH + _PM : _MH + _PM + lcs] = d["arr"][0, l0 - lcs : l0]
         # device-resident pubkey cache: decompressed points AND the raw
         # encodings (the SHA preimage needs A's 32 bytes on device)
         fp = (hashlib.sha256(pub_blob).digest(), b, "delta")
@@ -460,22 +474,9 @@ class Ed25519BatchVerifier(BatchVerifier):
                 _A_CACHE.pop(next(iter(_A_CACHE)))
         ok_a, neg_a, a_dev = cached
         global _LAST_WIRE_B_PER_LANE
-        _LAST_WIRE_B_PER_LANE = rs_mid.shape[1] + 1  # + mlens byte
+        _LAST_WIRE_B_PER_LANE = packed.shape[1]
         return verify_batch_delta_jit(
-            ok_a,
-            neg_a,
-            a_dev,
-            *jax.device_put(
-                (
-                    rs_mid,
-                    mlens,
-                    np.int32(lcp),
-                    np.int32(lcs),
-                    prefix,
-                    suffix,
-                    live,
-                )
-            ),
+            ok_a, neg_a, a_dev, *jax.device_put((packed, meta))
         )
 
     def _launch_device_sha(self):

@@ -23,11 +23,14 @@ class Perturbation:
     """At `at_height` (observed on any live node), apply `op` to `node`.
 
     ops: kill (SIGKILL, restarted after `down_s`), restart (graceful
-    stop + start), pause (SIGSTOP for `down_s`, then SIGCONT).
+    stop + start), pause (SIGSTOP for `down_s`, then SIGCONT),
+    partition (transport-level frame drop from every other node for
+    `down_s`, then heal — reference test/e2e/runner/perturb.go:31-90's
+    disconnect class, without needing network namespaces).
     """
 
     node: str
-    op: str  # kill | restart | pause
+    op: str  # kill | restart | pause | partition
     at_height: int
     down_s: float = 2.0
 
@@ -40,6 +43,8 @@ class Manifest:
     target_height: int = 12
     tx_rate: float = 5.0  # txs/sec across the net; 0 disables load
     timeout_s: float = 180.0
+    db_backend: str = "sqlite"
+    timeout_commit: float = 0.2
 
     @classmethod
     def parse(cls, d: dict) -> "Manifest":
@@ -52,4 +57,47 @@ class Manifest:
             target_height=int(d.get("target_height", 12)),
             tx_rate=float(d.get("tx_rate", 5.0)),
             timeout_s=float(d.get("timeout_s", 180.0)),
+            db_backend=d.get("db_backend", "sqlite"),
+            timeout_commit=float(d.get("timeout_commit", 0.2)),
         )
+
+
+def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
+    """Random testnet manifest (reference test/e2e/generator/generate.go:
+    randomized topology, db backend, timeouts, and a perturbation
+    schedule). Deterministic per seed so failures reproduce."""
+    import random
+
+    rng = random.Random(seed)
+    n_nodes = rng.choice([2, 3, 4])
+    nodes = [
+        NodeSpec(name=f"node{i}", power=rng.choice([10, 10, 20]))
+        for i in range(n_nodes)
+    ]
+    ops = ["kill", "restart", "pause", "partition"]
+    perturbations = []
+    # 1-2 perturbations at distinct heights, never two on one node at
+    # the same height; partitions only make sense with >= 3 nodes (a
+    # 2-node net cannot commit during one and merely stalls)
+    for k in range(rng.choice([1, 2])):
+        op = rng.choice(ops if n_nodes >= 3 else ops[:3])
+        perturbations.append(
+            Perturbation(
+                node=f"node{rng.randrange(n_nodes)}",
+                op=op,
+                at_height=3 + 3 * k,
+                down_s=rng.uniform(1.0, 2.5),
+            )
+        )
+    return Manifest(
+        chain_id=f"gen-{seed}",
+        nodes=nodes,
+        perturbations=perturbations,
+        target_height=target_height,
+        tx_rate=rng.choice([2.0, 5.0, 10.0]),
+        timeout_s=240.0,
+        # sqlite only: the invariant check reads the stores the stopped
+        # nodes leave on disk, which the mem backend would not persist
+        db_backend="sqlite",
+        timeout_commit=rng.choice([0.1, 0.2, 0.4]),
+    )

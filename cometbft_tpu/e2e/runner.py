@@ -143,7 +143,7 @@ class Runner:
             home = os.path.join(self.workdir, f"node{i}")
             cfg_file = os.path.join(home, "config", "config.toml")
             cfg = Config.load(cfg_file)
-            cfg.base.db_backend = "sqlite"
+            cfg.base.db_backend = m.db_backend
             cfg.base.crypto_backend = "cpu"
             cfg.consensus.timeout_propose = 0.6
             cfg.consensus.timeout_propose_delta = 0.2
@@ -151,10 +151,22 @@ class Runner:
             cfg.consensus.timeout_prevote_delta = 0.1
             cfg.consensus.timeout_precommit = 0.3
             cfg.consensus.timeout_precommit_delta = 0.1
-            cfg.consensus.timeout_commit = 0.2
+            cfg.consensus.timeout_commit = m.timeout_commit
+            cfg.p2p.fault_injection = True  # arm the partition channel
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
             self.nodes[spec.name] = _ProcNode(spec.name, home, port)
+
+    def _node_id(self, name: str) -> str:
+        """Peer id of a testnet node, derived from its generated key
+        (the partition control files identify peers by id)."""
+        from ..p2p.key import NodeKey
+
+        home = self.nodes[name].home
+        nk = NodeKey.load_or_generate(
+            os.path.join(home, "config", "node_key.json")
+        )
+        return nk.node_id()
 
     # ------------------------------------------------------------- drive
     def start(self) -> None:
@@ -168,13 +180,16 @@ class Runner:
 
     def _load_loop(self) -> None:
         """Round-robin tx load over node RPCs (reference
-        test/e2e/runner/load.go)."""
+        test/e2e/runner/load.go). Payloads carry the send timestamp so
+        the post-run latency report (reference test/loadtime/report) can
+        compute per-tx commit latency from block times alone."""
         i = 0
         interval = 1.0 / self.manifest.tx_rate
         nodes = list(self.nodes.values())
         while not self._load_stop.is_set():
             node = nodes[i % len(nodes)]
-            tx = f"load-{i}={os.urandom(8).hex()}".encode().hex()
+            t_ns = time.time_ns()
+            tx = f"load-{i}-{t_ns}={os.urandom(8).hex()}".encode().hex()
             try:
                 _rpc(node.rpc_port, "broadcast_tx_async", {"tx": tx})
                 self.txs_sent += 1
@@ -182,6 +197,56 @@ class Runner:
                 pass
             i += 1
             self._load_stop.wait(interval)
+
+    def latency_report(self) -> dict:
+        """Commit-latency distribution of the timestamped load txs,
+        computed from any stopped node's block store: latency = block
+        header time - the send time embedded in the payload (reference
+        test/loadtime/report/report.go). Call after run()/stop_all()."""
+        from ..storage import BlockStore, open_kv
+
+        lats: list[float] = []
+        for n in self.nodes.values():
+            path = os.path.join(n.home, "data", "blockstore.db")
+            if not os.path.exists(path):
+                continue
+            bs = BlockStore(open_kv(path))
+            for h in range(1, bs.height()):
+                blk = bs.load_block(h)
+                nxt = bs.load_block(h + 1)
+                if blk is None or nxt is None:
+                    continue
+                # BFT time: block h's own header time is the MEDIAN of
+                # the previous commit's vote times — the moment block h
+                # was actually committed is carried by block h+1's
+                # header (types/block.go MedianTime), so latency is
+                # measured against that (tip block's txs are skipped)
+                commit_ns = nxt.header.time.unix_ns()
+                for tx in blk.data.txs:
+                    if not tx.startswith(b"load-"):
+                        continue
+                    try:
+                        sent_ns = int(
+                            tx.split(b"=", 1)[0].split(b"-")[2]
+                        )
+                    except (IndexError, ValueError):
+                        continue
+                    lats.append((commit_ns - sent_ns) / 1e9)
+            break  # one store suffices: all nodes agree on blocks
+        if not lats:
+            return {"count": 0}
+        lats.sort()
+
+        def pct(p: float) -> float:
+            return round(lats[min(int(p * len(lats)), len(lats) - 1)], 4)
+
+        return {
+            "count": len(lats),
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+            "p99_s": pct(0.99),
+            "max_s": round(lats[-1], 4),
+        }
 
     def max_height(self) -> int:
         return max((n.height() for n in self.nodes.values()), default=-1)
@@ -233,8 +298,31 @@ class Runner:
             node.pause()
             time.sleep(p.down_s)
             node.resume()
+        elif p.op == "partition":
+            self._partition(p.node, True)
+            time.sleep(p.down_s)
+            self._partition(p.node, False)
         else:
             raise E2EError(f"unknown perturbation op {p.op!r}")
+
+    def _partition(self, name: str, up: bool) -> None:
+        """Isolate `name` from every other node (or heal): each side's
+        partition.json lists the peer ids it must drop/refuse; the
+        switches poll the file (p2p/switch.py watch_partition_file)."""
+        target_id = self._node_id(name)
+        for other, n in self.nodes.items():
+            blocked: list[str] = []
+            if up:
+                blocked = (
+                    [self._node_id(o) for o in self.nodes if o != name]
+                    if other == name
+                    else [target_id]
+                )
+            path = os.path.join(n.home, "data", "partition.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(blocked, f)
+            os.replace(tmp, path)  # atomic: pollers never see a partial
 
     def stop_all(self) -> None:
         self._load_stop.set()

@@ -291,11 +291,17 @@ class Node:
                 pruner=self.pruner,
             )
             self.grpc_privileged_server.start()
+        if self.config.p2p.fault_injection:
+            # fault-injection control channel for the e2e runner: a JSON
+            # list of blocked peer ids in the node home partitions this
+            # node at the transport level (no network namespaces needed)
+            self.switch.watch_partition_file(
+                self.config.path("data/partition.json")
+            )
         for hostp, portp in self.config.p2p.persistent_peer_list():
-            try:
-                self.switch.dial_peer(hostp, portp)
-            except Exception:  # noqa: BLE001 — reference retries async
-                pass
+            # the switch owns the retry loop: dialed immediately, then
+            # redialed with backoff whenever disconnected
+            self.switch.add_persistent_peer(hostp, portp)
         self.pruner.start()
         if self.pex_reactor is not None:
             self.pex_reactor.start()
@@ -405,3 +411,72 @@ class Node:
             self.grpc_privileged_server.stop()
         if hasattr(self.priv_validator, "close"):
             self.priv_validator.close()  # remote signer listener
+
+
+def bootstrap_state(config: Config, height: int = 0,
+                    rpc_servers: str = "",
+                    trust_height: int = 0, trust_hash: str = "") -> int:
+    """Seed a FRESH node's stores from light-client-verified state at
+    `height` without running a live node (reference node/node.go:150-259
+    BootstrapState): after this, `start` block-syncs from height+1
+    instead of replaying from genesis or needing live statesync.
+
+    The node home must hold genesis; the state store must be empty.
+    rpc_servers (comma-separated; falls back to config.statesync) supply
+    the light blocks; the trust anchor comes from the arguments or the
+    statesync config. height=0 bootstraps to the primary's latest - 2
+    (State() needs H+2 verifiable). Returns the bootstrapped height.
+    """
+    from ..light.client import LightClient
+    from ..light.provider_http import HTTPProvider
+    from ..statesync.provider import LightStateProvider
+    from ..storage import BlockStore, StateStore, open_kv
+    from ..types.genesis import GenesisDoc
+
+    genesis = GenesisDoc.load(config.path("config/genesis.json"))
+    servers = [
+        s.strip()
+        for s in (rpc_servers or config.statesync.rpc_servers).split(",")
+        if s.strip()
+    ]
+    if not servers:
+        raise ValueError("bootstrap-state needs at least one RPC server")
+    trust_height = trust_height or config.statesync.trust_height
+    trust_hash = trust_hash or config.statesync.trust_hash
+    if trust_height <= 0 or not trust_hash:
+        raise ValueError("bootstrap-state needs a trust height + hash")
+    mem = config.base.db_backend == "mem"
+    ss = StateStore(open_kv(None if mem else config.path("data/state.db")))
+    existing = ss.load()
+    if existing is not None and existing.last_block_height > 0:
+        raise ValueError(
+            f"state store already at height {existing.last_block_height}; "
+            "refusing to overwrite (reset first)"
+        )
+    primary, *witnesses = [
+        HTTPProvider(genesis.chain_id, url) for url in servers
+    ]
+    lc = LightClient(
+        genesis.chain_id,
+        primary=primary,
+        witnesses=witnesses,
+        trusting_period_s=config.statesync.trust_period_s,
+        backend=config.base.crypto_backend,
+    )
+    lc.initialize(trust_height, bytes.fromhex(trust_hash))
+    if height == 0:
+        latest = primary.light_block(0)
+        if latest is None:
+            raise ValueError("primary has no latest block")
+        height = max(latest.height - 2, trust_height)
+    provider = LightStateProvider(
+        lc, genesis.chain_id, initial_height=genesis.initial_height
+    )
+    state = provider.state(height)
+    commit = provider.commit(height)
+    ss.save(state)
+    bs = BlockStore(
+        open_kv(None if mem else config.path("data/blockstore.db"))
+    )
+    bs.save_seen_commit(height, commit)
+    return height

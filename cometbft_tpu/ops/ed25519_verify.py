@@ -117,6 +117,15 @@ def decompress_pubkeys(a_bytes):
 decompress_pubkeys_jit = jax.jit(decompress_pubkeys)
 
 
+# delta-wire meta-array layout, shared by the host packer
+# (crypto/ed25519._launch_device_delta) and the device unpacker
+# (verify_batch_delta): [plen, slen, n_lo, n_mid, n_hi, pad*3,
+# prefix[DELTA_PMAX], suffix[DELTA_PMAX]]
+DELTA_META_HEADER = 8
+DELTA_PMAX = 176  # >= MAX_INPUT_BYTES - 64 (max message length 175)
+DELTA_META_LEN = DELTA_META_HEADER + 2 * DELTA_PMAX
+
+
 def build_delta_msgs(a_enc, rs_mid, mlens, plen, slen, prefix, suffix):
     """Reconstruct the SHA-512-padded R||A||M blocks on device from a
     shared prefix/suffix plus per-lane delta bytes.
@@ -186,11 +195,27 @@ def build_delta_msgs(a_enc, rs_mid, mlens, plen, slen, prefix, suffix):
     return words, two
 
 
-def verify_batch_delta(ok_a, neg_a, a_enc, rs_mid, mlens, plen, slen,
-                       prefix, suffix, live):
+def verify_batch_delta(ok_a, neg_a, a_enc, packed, meta):
     """verify_batch with cached pubkeys AND device-side challenge
-    hashing over reconstructed messages (build_delta_msgs): the wire
-    carries R||S plus the per-lane delta only."""
+    hashing over reconstructed messages (build_delta_msgs).
+
+    The wire is exactly TWO host arrays per submit — each device_put
+    pays a fixed per-transfer cost on a tunneled runtime, which is why
+    the 96-byte path packs R||S||k into one array:
+      packed: (B, 64 + MIDMAX + 1) uint8 — R || S || mid || mlen.
+      meta:   (360,) uint8 — [plen, slen, n_lo, n_mid, n_hi, pad*3,
+              prefix[176], suffix[176]]; live lanes derive from n.
+    """
+    rs_mid = packed[:, :-1]
+    mlens = packed[:, -1]
+    meta32 = meta.astype(jnp.int32)
+    plen = meta32[0]
+    slen = meta32[1]
+    n = meta32[2] | (meta32[3] << 8) | (meta32[4] << 16)
+    live = jnp.arange(packed.shape[0], dtype=jnp.int32) < n
+    h = DELTA_META_HEADER
+    prefix = meta[h : h + DELTA_PMAX]
+    suffix = meta[h + DELTA_PMAX :]
     words, two = build_delta_msgs(
         a_enc, rs_mid, mlens, plen, slen, prefix, suffix
     )

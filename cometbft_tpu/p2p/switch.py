@@ -50,6 +50,16 @@ class Peer:
         self.mconn.stop()
 
 
+class DuplicatePeerError(ValueError):
+    """A connection to an already-connected (or self) peer id. Carries
+    the id so the persistent-peer redial loop can adopt an INBOUND
+    connection instead of re-dialing a connected peer forever."""
+
+    def __init__(self, peer_id: str):
+        super().__init__(f"duplicate or self peer {peer_id}")
+        self.peer_id = peer_id
+
+
 class Switch:
     def __init__(self, transport: Transport, send_rate: int | None = None,
                  recv_rate: int | None = None):
@@ -66,6 +76,19 @@ class Switch:
         self._stopped = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._upgrade_slots = threading.Semaphore(self.MAX_PENDING_UPGRADES)
+        # persistent peers: redialed with per-address exponential backoff
+        # for as long as they are disconnected (reference p2p/switch.go
+        # reconnectToPeer; a single swallowed dial failure at startup
+        # must not strand the node)
+        self._persistent: list[dict] = []
+        self._redial_thread: threading.Thread | None = None
+        # transport-level partition: peer ids in this set are dropped and
+        # refused (the e2e runner's network-partition perturbation — the
+        # reference uses docker disconnect, this needs no namespaces).
+        # Controlled directly (set_partition) or via a watched JSON file.
+        self._blocked: set[str] = set()
+        self.partition_file: str | None = None
+        self._partition_mtime: float = -1.0
 
     # ------------------------------------------------------------------
     def add_reactor(self, reactor: Reactor) -> None:
@@ -84,6 +107,110 @@ class Switch:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+
+    def add_persistent_peer(self, host: str, port: int) -> None:
+        """Register an address the switch keeps connected: dialed now and
+        redialed (0.5s tick, exponential backoff to 10s) whenever the
+        connection is absent."""
+        with self._lock:
+            self._persistent.append(
+                {"addr": (host, port), "peer_id": None,
+                 "backoff": 0.5, "next_try": 0.0}
+            )
+            if self._redial_thread is None:
+                self._redial_thread = threading.Thread(
+                    target=self._redial_loop, daemon=True,
+                    name="p2p-redial",
+                )
+                self._redial_thread.start()
+
+    def _redial_loop(self) -> None:
+        import time as _time
+
+        while not self._stopped.is_set():
+            self._poll_partition_file()
+            # partition enforcement sweep: catches peers whose handshake
+            # raced a set_partition call (admitted between the blocked
+            # check and registration)
+            if self._blocked:
+                for peer in self.peers():
+                    if peer.id in self._blocked:
+                        self.stop_peer_for_error(peer, "partitioned")
+            with self._lock:
+                entries = list(self._persistent)
+                connected = set(self._peers)
+            now = _time.monotonic()
+            for e in entries:
+                if e["peer_id"] is not None and e["peer_id"] in connected:
+                    e["backoff"] = 0.5
+                    continue
+                if now < e["next_try"]:
+                    continue
+                host, port = e["addr"]
+                try:
+                    peer = self.dial_peer(host, port)
+                    e["peer_id"] = peer.id
+                    e["backoff"] = 0.5
+                except DuplicatePeerError as dup:
+                    # the peer connected INBOUND: adopt its id so we
+                    # stop re-dialing a live connection
+                    e["peer_id"] = dup.peer_id
+                    e["backoff"] = 0.5
+                except Exception:  # noqa: BLE001 — retried with backoff
+                    e["peer_id"] = None
+                    e["next_try"] = now + e["backoff"]
+                    e["backoff"] = min(e["backoff"] * 2, 10.0)
+            self._stopped.wait(0.5)
+
+    # ---------------------------------------------- partition injection
+    def set_partition(self, blocked_ids) -> None:
+        """Drop and refuse the given peer ids until cleared (pass an
+        empty set to heal). Connected blocked peers are disconnected
+        immediately; the persistent-peer loop redials after healing."""
+        self._blocked = {str(b) for b in blocked_ids}
+        for peer in self.peers():
+            if peer.id in self._blocked:
+                self.stop_peer_for_error(peer, "partitioned")
+
+    def watch_partition_file(self, path: str) -> None:
+        """Poll `path` for a JSON list of blocked peer ids (runner ->
+        subprocess control channel; polled by the redial loop). Missing
+        file = no partition."""
+        self.partition_file = path
+        with self._lock:
+            if self._redial_thread is None:
+                self._redial_thread = threading.Thread(
+                    target=self._redial_loop, daemon=True,
+                    name="p2p-redial",
+                )
+                self._redial_thread.start()
+
+    def _poll_partition_file(self) -> None:
+        import json
+        import os
+
+        path = self.partition_file
+        if path is None:
+            return
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        if mtime == self._partition_mtime:
+            return
+        blocked: set[str] = set()
+        if mtime:
+            try:
+                with open(path) as f:
+                    blocked = set(json.load(f))
+            except (OSError, ValueError):
+                return  # partial write: mtime NOT recorded -> retried
+        # record the mtime only after a successful read, so a transient
+        # read failure doesn't permanently drop the update
+        self._partition_mtime = mtime
+        if blocked != self._blocked:
+            _log.info("partition update", blocked=len(blocked))
+            self.set_partition(blocked)
 
     MAX_PENDING_UPGRADES = 32  # reference p2p MaxIncomingConnections-style cap
 
@@ -142,10 +269,13 @@ class Switch:
                             recv_rate=self.recv_rate)
         peer = Peer(info, mconn, outbound)
         holder["peer"] = peer
+        if peer.id in self._blocked:
+            sconn.close()
+            raise ValueError(f"partitioned peer {peer.id}")
         with self._lock:
             if peer.id in self._peers or peer.id == self.transport.node_info.node_id:
                 sconn.close()
-                raise ValueError(f"duplicate or self peer {peer.id}")
+                raise DuplicatePeerError(peer.id)
             self._peers[peer.id] = peer
         mconn.start()
         _log.info("peer connected", peer=peer.id[:12], outbound=outbound)

@@ -205,6 +205,13 @@ class GrpcRPCClient:
         d = pb.fields_to_dict(out)
         return Block.decode(pb.as_bytes(d.get(2, b"")))
 
+    def get_latest_block(self):
+        from ..types.block import Block
+
+        out = self._call(BLOCK_SERVICE, "GetLatest")
+        d = pb.fields_to_dict(out)
+        return Block.decode(pb.as_bytes(d.get(2, b"")))
+
     def get_latest_height(self) -> int:
         out = self._call(BLOCK_SERVICE, "GetLatestHeight")
         return pb.to_i64(pb.fields_to_dict(out).get(1, 0))

@@ -102,3 +102,16 @@ def test_compact_reindex_debug(tmp_path):
     rec = txi.get(tmhash(b"cli=test"))
     assert rec is not None
     assert main(["--home", home, "compact-db"]) == 0
+
+
+def test_cli_bootstrap_state_requires_anchor(tmp_path):
+    """bootstrap-state fails cleanly without servers / trust anchor."""
+    home = str(tmp_path / "bs")
+    assert main(["--home", home, "init", "--chain-id", "bs-chain"]) == 0
+    # no rpc servers configured
+    assert main(["--home", home, "bootstrap-state"]) == 1
+    # servers but no trust anchor
+    assert main([
+        "--home", home, "bootstrap-state",
+        "--servers", "http://127.0.0.1:1",
+    ]) == 1

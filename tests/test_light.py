@@ -179,6 +179,8 @@ def test_client_detects_real_fork(chain):
             encode_validator_set(state2.validators),
         )
     w = StoreProvider(CHAIN, store2, ss2)
+    received = []
+    w.report_evidence = received.append
     anchor = _lb(p, 1)
     c = LightClient(CHAIN, p, witnesses=[w], store=LightStore(),
                     trusting_period_s=PERIOD, backend="cpu")
@@ -190,3 +192,12 @@ def test_client_detects_real_fork(chain):
     assert ev.common_height >= 1
     assert len(ev.byzantine_validators) >= 3  # all four signed both chains
     assert ev.conflicting_block.height == 7
+    # both directions reported (reference examines the primary's trace
+    # too): the witness — whose chain may be the canonical one — must
+    # receive evidence naming the PRIMARY's block, or a lying primary
+    # would halt the client without ever being prosecutable
+    primary_hash = _lb(p, 7).signed_header.header.hash()
+    assert any(
+        e.conflicting_block.signed_header.header.hash() == primary_hash
+        for e in received
+    ), "witness never got primary-direction evidence"

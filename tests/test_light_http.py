@@ -72,7 +72,7 @@ def test_light_client_tracks_live_net_over_http(tmp_path):
     n1 = _mk_node(tmp_path, "n1", keys[1], genesis, peers=f"{host}:{port}")
     n1.start()
     try:
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 150
         while time.monotonic() < deadline:
             if n0.consensus.sm_state.last_block_height >= 5:
                 break
@@ -185,7 +185,7 @@ def test_light_proxy_serves_verified_rpc(tmp_path):
     n1.start()
     proxy = None
     try:
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 150
         while time.monotonic() < deadline:
             if n0.consensus.sm_state.last_block_height >= 4:
                 break
@@ -230,3 +230,73 @@ def test_light_proxy_serves_verified_rpc(tmp_path):
             proxy.stop()
         n1.stop()
         n0.stop()
+
+
+def test_bootstrap_state_offline(tmp_path):
+    """Offline state bootstrap (reference node/node.go:150-259
+    BootstrapState): a fresh home's state store is seeded from
+    light-client-verified state over a live node's RPC, without running
+    statesync in a node."""
+    from cometbft_tpu.node.node import bootstrap_state
+    from cometbft_tpu.storage import StateStore, open_kv
+
+    tmp_path = str(tmp_path)
+    pvs = [FilePV.generate(None, None) for _ in range(2)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    keys = [
+        {
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }
+        for pv in pvs
+    ]
+    n0 = _mk_node(tmp_path, "b0", keys[0], genesis, rpc=True)
+    n0.start()
+    host, port = n0.listen_addr
+    n1 = _mk_node(tmp_path, "b1", keys[1], genesis, peers=f"{host}:{port}")
+    n1.start()
+    try:
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            if n0.consensus.sm_state.last_block_height >= 8:
+                break
+            time.sleep(0.2)
+        assert n0.consensus.sm_state.last_block_height >= 8, "net stalled"
+        rhost, rport = n0.rpc_addr
+        url = f"http://{rhost}:{rport}"
+        trust_blk = n0.block_store.load_block(2)
+        # fresh home for the bootstrapped node
+        home = os.path.join(tmp_path, "fresh")
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        cfg = Config()
+        cfg.base.home = home
+        cfg.base.db_backend = "sqlite"
+        cfg.base.crypto_backend = "cpu"
+        genesis.save(os.path.join(home, "config/genesis.json"))
+        h = bootstrap_state(
+            cfg, height=5, rpc_servers=url,
+            trust_height=2, trust_hash=trust_blk.hash().hex(),
+        )
+        assert h == 5
+        ss = StateStore(open_kv(os.path.join(home, "data/state.db")))
+        st = ss.load()
+        assert st is not None and st.last_block_height == 5
+        assert st.chain_id == CHAIN
+        # a second bootstrap must refuse to overwrite
+        with pytest.raises(ValueError, match="refusing to overwrite"):
+            bootstrap_state(
+                cfg, height=6, rpc_servers=url,
+                trust_height=2, trust_hash=trust_blk.hash().hex(),
+            )
+    finally:
+        n0.stop()
+        n1.stop()

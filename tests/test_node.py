@@ -97,7 +97,7 @@ def test_two_nodes_commit_over_tcp(tmp_path):
     n1 = _mk_node(tmp_path, "n1", keys[1], genesis, peers=f"{host}:{port}")
     n1.start()
     try:
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 150
         while time.monotonic() < deadline:
             if (
                 n0.consensus.sm_state.last_block_height >= 3

@@ -176,3 +176,64 @@ def test_sql_sink_blocks_txs_events(tmp_path):
     sink.index_block(1)
     assert sink.query("SELECT COUNT(*) FROM blocks") == [(2,)]
     sink.close()
+
+
+def test_sqlite_kv_iterate_prefix_long_suffixes():
+    """Keys extending far past the prefix with high bytes must still be
+    iterated: the upper bound is the incremented prefix, not a
+    fixed-width 0xff suffix (which silently excluded them)."""
+    from cometbft_tpu.storage.kv import SqliteKV
+
+    kv = SqliteKV(":memory:")
+    keys = [
+        b"P:" + b"\xff" * 16,          # high bytes, longer than 8 past prefix
+        b"P:" + b"\xfe" + b"\xff" * 20,
+        b"P:a",
+        b"P:",
+    ]
+    for k in keys:
+        kv.set(k, b"v")
+    kv.set(b"Q:x", b"other")           # outside the prefix
+    got = {k for k, _ in kv.iterate_prefix(b"P:")}
+    assert got == set(keys)
+    # all-0xff prefix: no upper bound, still prefix-filtered
+    kv.set(b"\xff\xff\x01", b"w")
+    got2 = {k for k, _ in kv.iterate_prefix(b"\xff\xff")}
+    assert got2 == {b"\xff\xff\x01"}
+
+
+def test_mempool_reactor_gossip_cap():
+    """max_gossip_peers caps fan-out per broadcast with a random sample
+    (not a fixed prefix, which would starve later peers)."""
+    from cometbft_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+
+    class FakePeer:
+        def __init__(self, i):
+            self.id = f"p{i}"
+            self.got = 0
+
+        def send(self, chan, payload):
+            assert chan == MEMPOOL_CHANNEL
+            self.got += 1
+
+    class FakeSwitch:
+        def __init__(self, peers):
+            self._p = peers
+
+        def peers(self):
+            return list(self._p)
+
+        def broadcast(self, chan, payload):
+            for p in self._p:
+                p.send(chan, payload)
+
+    class FakeMempool:
+        on_new_tx: list = []
+
+    peers = [FakePeer(i) for i in range(6)]
+    r = MempoolReactor(FakeMempool(), max_gossip_peers=2)
+    r.set_switch(FakeSwitch(peers))
+    for _ in range(60):
+        r._broadcast_tx(b"tx")
+    assert sum(p.got for p in peers) == 120  # 2 per broadcast
+    assert all(p.got > 0 for p in peers), "sampling must rotate peers"

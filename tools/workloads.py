@@ -107,11 +107,11 @@ def bench_replay(n_blocks=500, n_vals=100):
     # (a syncing node replays far more than one 500-block span)
     warm = ReplayEngine(
         store, BlockExecutor(AppConns(KVStoreApp())),
-        verify_mode="batched", window=32,
+        verify_mode="batched", window=128,
     )
     warm.run(genesis.copy())
     executor = BlockExecutor(AppConns(KVStoreApp()))
-    engine = ReplayEngine(store, executor, verify_mode="batched", window=32)
+    engine = ReplayEngine(store, executor, verify_mode="batched", window=128)
     t0 = time.perf_counter()
     state, stats = engine.run(genesis.copy())
     dt = time.perf_counter() - t0
