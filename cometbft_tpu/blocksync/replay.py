@@ -151,6 +151,7 @@ class ReplayEngine:
                     f"commit size {commit.size()} != validator set {len(vals)}"
                 )
             entries = []
+            msgs = commit.vote_sign_bytes_all(chain_id)
             for idx, cs in enumerate(commit.signatures):
                 if cs.is_absent() or (not all_sigs and not cs.is_commit()):
                     continue
@@ -159,7 +160,7 @@ class ReplayEngine:
                     raise ErrInvalidSignature(
                         f"address mismatch at height {height} index {idx}"
                     )
-                msg = commit.vote_sign_bytes(chain_id, idx)
+                msg = msgs[idx]
                 before = bv.count()
                 bv.add(val.pub_key, msg, cs.signature)
                 if bv.count() == before:

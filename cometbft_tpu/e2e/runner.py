@@ -206,11 +206,16 @@ class Runner:
         from ..storage import BlockStore, open_kv
 
         lats: list[float] = []
+        # read the TALLEST store: a perturbed node's store may stop
+        # short of the tip, silently dropping exactly the txs whose
+        # latency the perturbation inflated
+        stores = []
         for n in self.nodes.values():
             path = os.path.join(n.home, "data", "blockstore.db")
-            if not os.path.exists(path):
-                continue
-            bs = BlockStore(open_kv(path))
+            if os.path.exists(path):
+                stores.append(BlockStore(open_kv(path)))
+        stores.sort(key=lambda b: b.height(), reverse=True)
+        for bs in stores[:1]:
             for h in range(1, bs.height()):
                 blk = bs.load_block(h)
                 nxt = bs.load_block(h + 1)

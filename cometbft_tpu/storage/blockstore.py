@@ -108,7 +108,9 @@ class BlockStore:
 
     def load_block(self, height: int) -> Block | None:
         raw = self._db.get(_key_block(height))
-        return Block.decode(raw) if raw else None
+        # our own stored bytes are canonical by construction: stash them
+        # so BlockID/part-set work skips the re-encode
+        return Block.decode(raw, trusted_bytes=True) if raw else None
 
     def load_block_by_hash(self, block_hash: bytes) -> Block | None:
         """O(1) via the hash→height index written at save time

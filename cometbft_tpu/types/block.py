@@ -185,6 +185,62 @@ class CommitSig:
             signature=pb.as_bytes(d.get(4, b"")),
         )
 
+    @classmethod
+    def _decode_span(cls, buf: bytes, i: int, end: int) -> "CommitSig":
+        """Decode from buf[i:end] without slicing out sub-buffers: a
+        commit carries one of these per validator and the generic
+        dict-of-fields walk was replay's single largest host cost."""
+        rv = pb.read_uvarint
+        flag = 0
+        addr = b""
+        ts_s = 0  # bug-compatible with the generic decoder's absent-field default
+        ts_n = 0
+        sig = b""
+        while i < end:
+            tag, i = rv(buf, i)
+            f, wt = tag >> 3, tag & 7
+            if wt == 0:
+                v, i = rv(buf, i)
+                # a varint must not run past the span into the next
+                # field (the generic decoder's sub-buffer slice raised
+                # here; match it)
+                if i > end:
+                    raise ValueError("truncated varint in CommitSig")
+                if f == 1:
+                    flag = v
+            elif wt == 2:
+                ln, i = rv(buf, i)
+                j = i + ln
+                if j > end or i > end:
+                    raise ValueError("truncated commit sig field")
+                if f == 2:
+                    addr = buf[i:j]
+                elif f == 4:
+                    sig = buf[i:j]
+                elif f == 3:
+                    while i < j:
+                        t2, i = rv(buf, i)
+                        if t2 & 7 != 0:
+                            raise ValueError("bad timestamp wire type")
+                        v2, i = rv(buf, i)
+                        if i > j:
+                            raise ValueError("truncated timestamp varint")
+                        if t2 >> 3 == 1:
+                            ts_s = pb.to_i64(v2)
+                        elif t2 >> 3 == 2:
+                            ts_n = pb.to_i64(v2)
+                i = j
+            else:
+                raise ValueError(f"unsupported wire type {wt} in CommitSig")
+        if i > end:
+            raise ValueError("truncated varint in CommitSig")
+        return cls(
+            block_id_flag=BlockIDFlag(flag),
+            validator_address=addr,
+            timestamp=Timestamp(ts_s, ts_n),
+            signature=sig,
+        )
+
 
 @dataclass
 class Commit:
@@ -210,15 +266,9 @@ class Commit:
     def size(self) -> int:
         return len(self.signatures)
 
-    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
-        """Rebuild the canonical precommit bytes validator idx signed
-        (reference types/block.go:879).
-
-        Byte-identical to canonical_vote_bytes; the commit-invariant
-        prefix (type, height, round, block id) and suffix (chain id) are
-        built once per Commit — verify_commit calls this for every
-        validator and the per-call proto assembly was half its cost."""
-        cs = self.signatures[idx]
+    def _sb_parts(self, chain_id: str):
+        """Commit-invariant sign-bytes parts (prefix variants + chain-id
+        tail), cached per (Commit, chain_id)."""
         cache = self.__dict__.get("_sb_cache")
         if cache is None or cache[0] != chain_id:
             head = (
@@ -233,6 +283,18 @@ class Commit:
                 pb.f_string(6, chain_id),
             )
             self.__dict__["_sb_cache"] = cache
+        return cache
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Rebuild the canonical precommit bytes validator idx signed
+        (reference types/block.go:879).
+
+        Byte-identical to canonical_vote_bytes; the commit-invariant
+        prefix (type, height, round, block id) and suffix (chain id) are
+        built once per Commit — verify_commit calls this for every
+        validator and the per-call proto assembly was half its cost."""
+        cs = self.signatures[idx]
+        cache = self._sb_parts(chain_id)
         _, with_bid, nil_bid, tail = cache
         is_commit = cs.block_id_flag == BlockIDFlag.COMMIT
         key = (cache, is_commit, cs.timestamp)
@@ -250,6 +312,33 @@ class Commit:
         cs.__dict__["_sb"] = (key, out)
         return out
 
+    def vote_sign_bytes_all(self, chain_id: str) -> list:
+        """Sign bytes for every slot in one pass (None for absent).
+
+        Byte-identical to vote_sign_bytes per index, minus the per-call
+        memo machinery: window replay builds a hundred of these per
+        block, where the per-slot work is just prefix + timestamp
+        varints + tail."""
+        if not self.signatures:
+            return []
+        _, with_bid, nil_bid, tail = self._sb_parts(chain_id)
+        lp = pb.length_prefixed
+        fv = pb.f_varint
+        fe = pb.f_embedded
+        commit_flag = BlockIDFlag.COMMIT
+        absent_flag = BlockIDFlag.ABSENT
+        out = []
+        for cs in self.signatures:
+            if cs.block_id_flag == absent_flag:
+                out.append(None)
+                continue
+            ts = cs.timestamp
+            prefix = with_bid if cs.block_id_flag == commit_flag else nil_bid
+            out.append(
+                lp(prefix + fe(5, fv(1, ts.seconds) + fv(2, ts.nanos)) + tail)
+            )
+        return out
+
     def encode(self) -> bytes:
         out = (
             pb.f_varint(1, self.height)
@@ -262,18 +351,35 @@ class Commit:
 
     @classmethod
     def decode(cls, buf: bytes) -> "Commit":
+        # specialized walk (one pass, no per-sig sub-buffer dicts): the
+        # signature list dominates and replay decodes one commit per
+        # block
         height = round_ = 0
         block_id = ZERO_BLOCK_ID
         sigs = []
-        for f, _, v in pb.parse_fields(buf):
-            if f == 1:
-                height = pb.to_i64(v)
-            elif f == 2:
-                round_ = pb.to_i64(v)
-            elif f == 3:
-                block_id = BlockID.decode(pb.as_bytes(v))
-            elif f == 4:
-                sigs.append(CommitSig.decode(pb.as_bytes(v)))
+        rv = pb.read_uvarint
+        i, n = 0, len(buf)
+        while i < n:
+            tag, i = rv(buf, i)
+            f, wt = tag >> 3, tag & 7
+            if wt == 0:
+                v, i = rv(buf, i)
+                if f == 1:
+                    height = pb.to_i64(v)
+                elif f == 2:
+                    round_ = pb.to_i64(v)
+            elif wt == 2:
+                ln, i = rv(buf, i)
+                j = i + ln
+                if j > n:
+                    raise ValueError("truncated commit field")
+                if f == 4:
+                    sigs.append(CommitSig._decode_span(buf, i, j))
+                elif f == 3:
+                    block_id = BlockID.decode(buf[i:j])
+                i = j
+            else:
+                raise ValueError(f"unsupported wire type {wt} in Commit")
         return cls(height, round_, block_id, sigs)
 
 
@@ -294,7 +400,13 @@ def block_id_for(block: "Block") -> BlockID:
         return memo
     from .part_set import PartSet
 
-    ps = PartSet.from_data(block.encode())
+    # blocks decoded with trusted_bytes=True carry their own canonical
+    # store bytes; encode() itself stays memo-free so post-decode
+    # mutations (e.g. re-saving an edited block) always re-encode
+    enc = block.__dict__.get("_enc_memo")
+    if enc is None:
+        enc = block.encode()
+    ps = PartSet.from_data(enc)
     bid = BlockID(block.hash(), ps.header)
     block.__dict__["_bid_memo"] = bid
     return bid
@@ -339,7 +451,12 @@ class Block:
         )
 
     @classmethod
-    def decode(cls, buf: bytes) -> "Block":
+    def decode(cls, buf: bytes, trusted_bytes: bool = False) -> "Block":
+        """trusted_bytes=True stashes `buf` as the encode memo — ONLY
+        for bytes this node wrote itself (the block store): re-encoding
+        for BlockID/part-set work then reuses them. Wire-received bytes
+        must never be trusted here (a non-canonical adversarial encoding
+        would define this node's BlockID)."""
         from .evidence import decode_evidence
 
         d = pb.fields_to_dict(buf)
@@ -347,9 +464,12 @@ class Block:
         for f, _, v in pb.parse_fields(pb.as_bytes(d.get(3, b""))):
             if f == 1:
                 evidence.append(decode_evidence(pb.as_bytes(v)))
-        return cls(
+        blk = cls(
             header=Header.decode(pb.as_bytes(d.get(1, b""))),
             data=Data.decode(pb.as_bytes(d.get(2, b""))),
             evidence=evidence,
             last_commit=Commit.decode(pb.as_bytes(d.get(4, b""))) if 4 in d else Commit(),
         )
+        if trusted_bytes:
+            blk.__dict__["_enc_memo"] = bytes(buf)
+        return blk

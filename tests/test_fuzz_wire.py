@@ -193,3 +193,26 @@ def test_mconnection_rate_enforcement():
     slow = run_once(32_000)  # 20 KiB at 32 KB/s: ~0.6 s of budget waits
     assert slow > 0.3, f"rate limit not enforced: {slow:.3f}s"
     assert slow > 3 * fast, f"no separation: fast={fast:.3f}s slow={slow:.3f}s"
+
+
+def test_commit_sig_span_overrun_rejected():
+    """A CommitSig span ending mid-varint (continuation bit set at the
+    span boundary) must raise, not silently consume the next field's
+    bytes — the specialized span decoder must match the generic
+    sub-buffer decoder's strictness."""
+    import pytest
+
+    from cometbft_tpu.encoding import proto as pb
+    from cometbft_tpu.types.block import Commit
+
+    # commit with one malformed sig entry: field1 varint whose last
+    # byte keeps the continuation bit, followed by a second sig entry
+    bad_sig = b"\x08\xff"  # field 1 varint, truncated (cont. bit set)
+    good_sig = pb.f_varint(1, 2) + pb.f_bytes(2, b"a" * 20) + pb.f_bytes(4, b"s" * 64)
+    buf = (
+        pb.f_varint(1, 5)
+        + pb.f_embedded(4, bad_sig)
+        + pb.f_embedded(4, good_sig)
+    )
+    with pytest.raises(ValueError):
+        Commit.decode(buf)
