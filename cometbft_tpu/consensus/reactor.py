@@ -301,6 +301,7 @@ class PeerState:
         self.catchup_height = 0
         self.catchup_time = 0.0  # last catchup (re)start, for retry
         self.last_maj23_query = 0.0
+        self.last_step_send = 0.0  # periodic NewRoundStep re-send
         # (height, round, type) -> set of validator indexes known to peer
         self.votes_seen: dict[tuple[int, int, int], set[int]] = {}
 
@@ -657,12 +658,33 @@ class ConsensusReactor(Reactor):
                 sent = self._gossip_data(ps)
                 sent = self._gossip_votes(ps) or sent
                 self._maybe_query_maj23(ps)
+                self._maybe_resend_step(ps)
             except Exception as e:  # noqa: BLE001 — peer loops must survive
                 _log.warn("gossip error", peer=ps.peer.id[:8],
                           err=f"{type(e).__name__}: {e}"[:120])
                 sent = False
             if not sent:
                 time.sleep(self.GOSSIP_SLEEP_S)
+
+    STEP_RESEND_S = 2.0
+
+    def _maybe_resend_step(self, ps: PeerState) -> None:
+        """Re-broadcast our NewRoundStep to this peer periodically.
+
+        State sync otherwise rests on the single add_peer-time send plus
+        step-change broadcasts; if a peer misses those while both nodes
+        are idle-waiting (no +2/3 -> no timeouts armed -> no new steps),
+        its stale view of us (height 0) keeps its gossip routine from
+        sending the very votes that would unstick the round — a mutual
+        stall observed live on two-validator nets. A 2 s heartbeat of
+        ~30 bytes makes peer state self-healing."""
+        now = time.monotonic()
+        if now - ps.last_step_send < self.STEP_RESEND_S:
+            return
+        ps.last_step_send = now
+        ps.peer.send(
+            STATE_CHANNEL, encode_consensus_msg(self._our_step_msg())
+        )
 
     def _maybe_query_maj23(self, ps: PeerState) -> None:
         """Periodically tell a same-height peer which blocks we see +2/3

@@ -277,11 +277,35 @@ class Switch:
                 sconn.close()
                 raise DuplicatePeerError(peer.id)
             self._peers[peer.id] = peer
-        mconn.start()
+        # register with the reactors BEFORE the connection starts
+        # delivering: a message that arrives between mconn.start and a
+        # reactor's add_peer would find no per-peer state and be dropped
+        # — fatal for one-shot handshake messages like the consensus
+        # NewRoundStep (sends made here queue in the mconn and flush on
+        # start). On any failure, unwind fully: a half-registered peer
+        # whose mconn never starts has no error path to clean it up and
+        # would permanently block reconnects as a duplicate.
+        added = []
+        try:
+            for r in self._reactors:
+                r.add_peer(peer)
+                added.append(r)
+            mconn.start()
+        except Exception:
+            with self._lock:
+                self._peers.pop(peer.id, None)
+            for r in added:
+                try:
+                    r.remove_peer(peer, "registration failed")
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            try:
+                sconn.close()
+            except OSError:
+                pass
+            raise
         _log.info("peer connected", peer=peer.id[:12], outbound=outbound)
         p2p_metrics().peers.set(len(self._peers))
-        for r in self._reactors:
-            r.add_peer(peer)
         return peer
 
     # ------------------------------------------------------------------

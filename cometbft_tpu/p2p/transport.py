@@ -10,10 +10,14 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ..encoding import proto as pb
+from ..utils.log import logger
 from .key import NodeKey
+
+_log = logger("p2p")
 from .secret_connection import SecretConnection
 
 
@@ -66,6 +70,7 @@ class Transport:
         self.node_info = node_info
         self._listener: socket.socket | None = None
         self._stopped = threading.Event()
+        self._last_accept_warn = 0.0
 
     # ------------------------------------------------------------------
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
@@ -89,8 +94,23 @@ class Transport:
                 raw, _ = self._listener.accept()
             except socket.timeout:
                 continue
-            except OSError:
-                return None
+            except OSError as e:
+                if self._stopped.is_set():
+                    return None  # listener closed by close()
+                # transient accept failure (EMFILE while a neighboring
+                # process churns descriptors, interrupted syscall, ...):
+                # a permanent return here would silently kill inbound
+                # peer admission for the node's remaining lifetime.
+                # Pause briefly so a hot error can't spin on the GIL,
+                # and log (rate-limited) so a PERMANENTLY broken
+                # listener is visible to operators.
+                now = time.monotonic()
+                if now - self._last_accept_warn > 5.0:
+                    self._last_accept_warn = now
+                    _log.warn("accept failed; retrying",
+                              err=f"{type(e).__name__}: {e}"[:80])
+                time.sleep(0.05)
+                continue
             return raw
         return None
 
