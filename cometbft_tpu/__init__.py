@@ -16,15 +16,7 @@ whose *behavior* (not code) each component mirrors.
 
 __version__ = "0.3.0"
 
-# Persistent XLA compilation cache: the crypto kernels compile in tens
-# of seconds; without a disk cache every fresh process pays that again
-# before its first verification. Harmless when jax is never imported.
-import os as _os
-
-_os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    _os.path.join(
-        _os.environ.get("XDG_CACHE_HOME", _os.path.expanduser("~/.cache")),
-        "cometbft_tpu", "jax",
-    ),
-)
+# The persistent XLA compilation cache is configured in
+# cometbft_tpu/ops/__init__.py (every device-kernel path imports it);
+# this jax build ignores the JAX_COMPILATION_CACHE_DIR env var, so the
+# config must be applied via jax.config.update after jax is imported.

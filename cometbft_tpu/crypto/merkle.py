@@ -39,14 +39,30 @@ def _split_point(n: int) -> int:
     return k
 
 
+_NATIVE_MIN = 8  # below this the ctypes call setup beats the win
+
+
 def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    n = len(items)
+    if n >= _NATIVE_MIN:
+        # one C call for the whole tree (SHA-NI when the host has it):
+        # commits re-merkle 100+ signature encodings per block and the
+        # per-hash hashlib round trips were a measured replay hot spot
+        from . import native
+
+        if native.available():
+            return native.merkle_root(items)
+    return _hash_pure(items)
+
+
+def _hash_pure(items: list[bytes]) -> bytes:
     n = len(items)
     if n == 0:
         return _sha256(b"")
     if n == 1:
         return leaf_hash(items[0])
     k = _split_point(n)
-    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+    return inner_hash(_hash_pure(items[:k]), _hash_pure(items[k:]))
 
 
 @dataclass

@@ -23,7 +23,11 @@ _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc",
                     "ed25519_native.cpp")
 # sources whose edits must trigger a rebuild (the .cpp includes the
 # IFMA engine from the .inc)
-_SRC_DEPS = (_SRC, os.path.join(os.path.dirname(_SRC), "ed25519_ifma.inc"))
+_SRC_DEPS = (
+    _SRC,
+    os.path.join(os.path.dirname(_SRC), "ed25519_ifma.inc"),
+    os.path.join(os.path.dirname(_SRC), "merkle_native.inc"),
+)
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
 _lock = threading.Lock()
@@ -82,6 +86,19 @@ def get_lib():
         ]
         lib.ed25519_engine.restype = ctypes.c_int
         lib.ed25519_engine.argtypes = []
+        lib.merkle_root_native.restype = None
+        lib.merkle_root_native.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+        ]
+        lib.sha256_oneshot.restype = None
+        lib.sha256_oneshot.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.sha256_engine.restype = ctypes.c_int
+        lib.sha256_engine.argtypes = []
+        lib.sha256_force_portable.restype = None
+        lib.sha256_force_portable.argtypes = [ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -133,6 +150,49 @@ def batch_verify(items) -> bool:
     msgs = b"".join(it[1] for it in items)
     lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
     return bool(lib.ed25519_batch_verify(n, pubs, msgs, lens, sigs))
+
+
+def merkle_root(items) -> bytes:
+    """RFC-6962 merkle root of a list of byte leaves in one C call
+    (leaf/inner prefixes per reference crypto/merkle/hash.go); raises
+    RuntimeError if the native lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native merkle unavailable")
+    n = len(items)
+    offs = (ctypes.c_uint64 * (n + 1))()
+    pos = 0
+    for i, it in enumerate(items):
+        offs[i] = pos
+        pos += len(it)
+    offs[n] = pos
+    out = ctypes.create_string_buffer(32)
+    lib.merkle_root_native(n, b"".join(items), offs, out)
+    return out.raw
+
+
+def sha256(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native sha256 unavailable")
+    out = ctypes.create_string_buffer(32)
+    lib.sha256_oneshot(data, len(data), out)
+    return out.raw
+
+
+def sha256_engine() -> str:
+    lib = get_lib()
+    if lib is None:
+        return "unavailable"
+    return "sha-ni" if lib.sha256_engine() else "portable"
+
+
+def sha256_force_portable(on: bool) -> None:
+    """Test hook: pin the portable scalar compression so differential
+    tests exercise both engines on a SHA-NI host."""
+    lib = get_lib()
+    if lib is not None:
+        lib.sha256_force_portable(1 if on else 0)
 
 
 def pubkey(seed: bytes) -> bytes:

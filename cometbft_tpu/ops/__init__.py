@@ -4,3 +4,29 @@ Layout convention: field elements are int32 arrays of shape (NLIMBS, B)
 with the *batch* on the trailing axis, so every limb operation is a wide
 vector op across TPU lanes and carry chains walk the (small) leading axis.
 """
+
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: the verify graph compiles in
+# 20-40 s and the MSM accumulate kernel in ~2 min; without a disk cache
+# every fresh process (each test run, each bench invocation) pays that
+# again before its first verification. The JAX_COMPILATION_CACHE_DIR
+# env var set in the package root is not honored by this jax build, so
+# the config is applied here — every kernel module imports this package
+# and jax is being imported anyway.
+if _jax.config.jax_compilation_cache_dir is None:
+    _jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            _os.path.join(
+                _os.environ.get(
+                    "XDG_CACHE_HOME", _os.path.expanduser("~/.cache")
+                ),
+                "cometbft_tpu",
+                "jax",
+            ),
+        ),
+    )

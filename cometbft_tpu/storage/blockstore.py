@@ -123,11 +123,11 @@ class BlockStore:
     def load_block_commit(self, height: int) -> Commit | None:
         """The canonical commit FOR `height` (stored with block height+1)."""
         raw = self._db.get(_key_commit(height))
-        return Commit.decode(raw) if raw else None
+        return Commit.decode(raw, trusted_bytes=True) if raw else None
 
     def load_seen_commit(self, height: int) -> Commit | None:
         raw = self._db.get(_key_seen_commit(height))
-        return Commit.decode(raw) if raw else None
+        return Commit.decode(raw, trusted_bytes=True) if raw else None
 
     def save_extended_commit(self, ext_commit) -> None:
         """Seen commit WITH vote extensions (reference SaveBlockWithExtendedCommit

@@ -257,9 +257,16 @@ class Commit:
         # height otherwise
         h = self.__dict__.get("_hash_memo")
         if h is None:
-            h = merkle.hash_from_byte_slices(
-                [cs.encode() for cs in self.signatures]
-            )
+            # leaves are each slot's canonical encoding; a commit decoded
+            # from bytes THIS node wrote (trusted_bytes) reuses the decode
+            # spans — byte-identical to cs.encode() since our own encoder
+            # produced them (wire-received commits never take this path:
+            # a non-canonical adversarial encoding must not define the
+            # hash)
+            leaves = self.__dict__.get("_sig_spans")
+            if leaves is None:
+                leaves = [cs.encode() for cs in self.signatures]
+            h = merkle.hash_from_byte_slices(leaves)
             self.__dict__["_hash_memo"] = h
         return h
 
@@ -350,13 +357,15 @@ class Commit:
         return out
 
     @classmethod
-    def decode(cls, buf: bytes) -> "Commit":
+    def decode(cls, buf: bytes, trusted_bytes: bool = False) -> "Commit":
         # specialized walk (one pass, no per-sig sub-buffer dicts): the
         # signature list dominates and replay decodes one commit per
-        # block
+        # block. trusted_bytes (store-loaded only) additionally stashes
+        # each slot's wire span as its canonical encoding for hash()
         height = round_ = 0
         block_id = ZERO_BLOCK_ID
         sigs = []
+        spans = [] if trusted_bytes else None
         rv = pb.read_uvarint
         i, n = 0, len(buf)
         while i < n:
@@ -375,12 +384,17 @@ class Commit:
                     raise ValueError("truncated commit field")
                 if f == 4:
                     sigs.append(CommitSig._decode_span(buf, i, j))
+                    if spans is not None:
+                        spans.append(buf[i:j])
                 elif f == 3:
                     block_id = BlockID.decode(buf[i:j])
                 i = j
             else:
                 raise ValueError(f"unsupported wire type {wt} in Commit")
-        return cls(height, round_, block_id, sigs)
+        commit = cls(height, round_, block_id, sigs)
+        if spans is not None:
+            commit.__dict__["_sig_spans"] = spans
+        return commit
 
 
 def tx_hash(tx: bytes) -> bytes:
@@ -468,7 +482,11 @@ class Block:
             header=Header.decode(pb.as_bytes(d.get(1, b""))),
             data=Data.decode(pb.as_bytes(d.get(2, b""))),
             evidence=evidence,
-            last_commit=Commit.decode(pb.as_bytes(d.get(4, b""))) if 4 in d else Commit(),
+            last_commit=(
+                Commit.decode(pb.as_bytes(d.get(4, b"")), trusted_bytes=trusted_bytes)
+                if 4 in d
+                else Commit()
+            ),
         )
         if trusted_bytes:
             blk.__dict__["_enc_memo"] = bytes(buf)

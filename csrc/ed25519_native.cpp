@@ -1145,3 +1145,6 @@ void sha512_digest(const u8 *msg, u64 len, u8 *out) {
 }
 
 }  // extern "C"
+
+// SHA-256 + RFC-6962 merkle root engine (own extern "C" exports)
+#include "merkle_native.inc"

@@ -111,3 +111,29 @@ def test_commit_vote_sign_bytes_matches_canonical():
                 chain_id,
             )
             assert commit.vote_sign_bytes(chain_id, idx) == want
+
+
+def test_commit_hash_trusted_spans_match_encode():
+    """A commit decoded with trusted_bytes=True hashes via its decode
+    spans; that must equal the canonical encode-based hash (same bytes:
+    our own encoder wrote them)."""
+    from cometbft_tpu.types import Commit
+    from cometbft_tpu.types.block import BlockIDFlag, CommitSig
+    from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+
+    commit = Commit(
+        height=7,
+        round=1,
+        block_id=BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32)),
+        signatures=[
+            CommitSig(BlockIDFlag.COMMIT, bytes([i]) * 20,
+                      Timestamp(1_700_000_000 + i, i * 13), bytes([i]) * 64)
+            for i in range(5)
+        ] + [CommitSig(BlockIDFlag.ABSENT, b"", Timestamp(0, 0), b"")],
+    )
+    wire = commit.encode()
+    untrusted = Commit.decode(wire)
+    trusted = Commit.decode(wire, trusted_bytes=True)
+    assert "_sig_spans" in trusted.__dict__
+    assert "_sig_spans" not in untrusted.__dict__
+    assert trusted.hash() == untrusted.hash() == commit.hash()

@@ -82,3 +82,47 @@ def test_proof_operators_chain():
     # unconsumed path fails
     with pytest.raises(ProofError):
         verify_ops(ops, app_hash, [b"extra", key], value)
+
+
+def test_native_merkle_matches_pure():
+    """The one-C-call tree (SHA-NI or portable) is byte-identical to the
+    recursive hashlib implementation on every size class: empty, single
+    leaf, perfect and ragged trees, empty leaves."""
+    import random
+
+    from cometbft_tpu.crypto import merkle, native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = random.Random(42)
+    for n in [0, 1, 2, 3, 4, 7, 8, 9, 31, 100, 257]:
+        items = [rng.randbytes(rng.randint(0, 300)) for _ in range(n)]
+        assert native.merkle_root(items) == merkle._hash_pure(items), n
+        assert merkle.hash_from_byte_slices(items) == merkle._hash_pure(items), n
+
+
+def test_native_sha256_matches_hashlib():
+    """Both compressions — the CPU-selected one AND the forced-portable
+    scalar — must match hashlib on every padding boundary; on a SHA-NI
+    host this is the only coverage the scalar path (the aarch64 /
+    pre-SHA-NI default) gets."""
+    import random
+
+    from cometbft_tpu.crypto import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = random.Random(1)
+    cases = [rng.randbytes(ln)
+             for ln in [0, 1, 54, 55, 56, 57, 63, 64, 65, 127, 128, 1000, 10000]]
+    try:
+        for force in (False, True):
+            native.sha256_force_portable(force)
+            for d in cases:
+                assert native.sha256(d) == hashlib.sha256(d).digest(), (force, len(d))
+            items = [rng.randbytes(rng.randint(0, 300)) for _ in range(100)]
+            from cometbft_tpu.crypto import merkle
+
+            assert native.merkle_root(items) == merkle._hash_pure(items)
+    finally:
+        native.sha256_force_portable(False)
