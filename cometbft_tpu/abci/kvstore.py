@@ -54,6 +54,7 @@ class KVStoreApp(Application):
         self._snapshots: dict[int, tuple[Snapshot, list[bytes]]] = {}
         self._restore: dict | None = None  # in-progress state-sync restore
         self._acc = 0  # multiset digest of `store` (excludes pending)
+        self._staged_cache = None  # finalize-computed digest, consumed by commit
 
     # --- helpers ---
     @staticmethod
@@ -151,7 +152,11 @@ class KVStoreApp(Application):
                     continue
             self.pending[k] = v
             results.append(ExecTxResult(data=v))
-        app_hash = self._compute_hash(req.height)
+        # computed once here; commit() reuses it (the per-entry digest
+        # expansion is 9 SHA-256 calls per pending key)
+        staged = self._staged_acc()
+        self._staged_cache = staged
+        app_hash = self._hash_of(req.height, staged)
         return FinalizeBlockResponse(
             tx_results=results,
             validator_updates=list(self.val_updates),
@@ -159,7 +164,9 @@ class KVStoreApp(Application):
         )
 
     def commit(self) -> int:
-        self._acc = self._staged_acc()
+        staged = getattr(self, "_staged_cache", None)
+        self._acc = staged if staged is not None else self._staged_acc()
+        self._staged_cache = None
         self.store.update(self.pending)
         self.pending = {}
         self.height += 1
@@ -250,6 +257,7 @@ class KVStoreApp(Application):
             return ApplySnapshotChunkResult.REJECT_SNAPSHOT
         self.store = store
         self.pending = {}
+        self._staged_cache = None
         self.height = height
         self._acc = staged_acc
         self.app_hash = staged_hash

@@ -27,6 +27,7 @@ _SRC_DEPS = (
     _SRC,
     os.path.join(os.path.dirname(_SRC), "ed25519_ifma.inc"),
     os.path.join(os.path.dirname(_SRC), "merkle_native.inc"),
+    os.path.join(os.path.dirname(_SRC), "commit_codec.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -99,6 +100,17 @@ def get_lib():
         lib.sha256_engine.argtypes = []
         lib.sha256_force_portable.restype = None
         lib.sha256_force_portable.argtypes = [ctypes.c_int]
+        lib.commit_parse.restype = ctypes.c_long
+        lib.commit_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),                    # head
+            ctypes.c_char_p,                                    # flags
+            ctypes.c_char_p, ctypes.c_char_p,                   # addr_lens, addrs
+            ctypes.POINTER(ctypes.c_int64),                     # ts_s
+            ctypes.POINTER(ctypes.c_int64),                     # ts_n
+            ctypes.c_char_p, ctypes.c_char_p,                   # sig_lens, sigs
+            ctypes.POINTER(ctypes.c_uint64),                    # spans
+        ]
         _lib = lib
         return _lib
 
@@ -150,6 +162,45 @@ def batch_verify(items) -> bool:
     msgs = b"".join(it[1] for it in items)
     lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
     return bool(lib.ed25519_batch_verify(n, pubs, msgs, lens, sigs))
+
+
+def commit_parse(buf: bytes):
+    """Columnar parse of a Commit wire buffer's signature list in one C
+    call. Returns (height_u64, round_u64, bid_span, cols) where cols =
+    (count, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs, spans),
+    or None when the native lib is absent or the buffer needs the
+    (bug-compatible, stricter-error) Python path."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = len(buf) // 6 + 4
+    while True:
+        head = (ctypes.c_uint64 * 4)()
+        flags = ctypes.create_string_buffer(cap)
+        addr_lens = ctypes.create_string_buffer(cap)
+        addrs = ctypes.create_string_buffer(cap * 20)
+        ts_s = (ctypes.c_int64 * cap)()
+        ts_n = (ctypes.c_int64 * cap)()
+        sig_lens = ctypes.create_string_buffer(cap)
+        sigs = ctypes.create_string_buffer(cap * 64)
+        spans = (ctypes.c_uint64 * (cap * 2))()
+        rc = lib.commit_parse(
+            buf, len(buf), cap, head, flags, addr_lens, addrs,
+            ts_s, ts_n, sig_lens, sigs, spans,
+        )
+        if rc == -2:
+            cap *= 2
+            continue
+        if rc < 0:
+            return None
+        n = int(rc)
+        return (
+            int(head[0]),
+            int(head[1]),
+            (int(head[2]), int(head[3])),
+            (n, flags.raw, addr_lens.raw, addrs.raw, ts_s, ts_n,
+             sig_lens.raw, sigs.raw, spans),
+        )
 
 
 def merkle_root(items) -> bytes:

@@ -367,13 +367,18 @@ class BlockExecutor:
             n_vals.update_with_change_set(changes)
             changed = block.header.height + 2
         n_vals.increment_proposer_priority(1)
+        # no defensive copies for the rotated sets: every mutator in the
+        # codebase (here and consensus enter_new_round) operates on a
+        # private .copy() first, so ValidatorSet objects reachable from
+        # a State are never mutated in place — sharing them across the
+        # rotation is safe and saves 2 full-set copies per block
         return replace(
             state,
             last_block_height=block.header.height,
             last_block_id=block_id,
             last_block_time=block.header.time,
-            last_validators=state.validators.copy(),
-            validators=state.next_validators.copy(),
+            last_validators=state.validators,
+            validators=state.next_validators,
             next_validators=n_vals,
             last_height_validators_changed=changed,
             last_results_hash=results_hash(resp.tx_results),

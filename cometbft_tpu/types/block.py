@@ -32,6 +32,11 @@ class BlockIDFlag(enum.IntEnum):
     NIL = 3
 
 
+# IntEnum.__call__ is slow; the columnar decode loop looks flags up here
+# (misses fall through to the constructor, which raises for bad values)
+_FLAG_CACHE = {f.value: f for f in BlockIDFlag}
+
+
 def _wrap_string(s: str) -> bytes:
     return pb.f_string(1, s) if s else b""
 
@@ -358,6 +363,49 @@ class Commit:
 
     @classmethod
     def decode(cls, buf: bytes, trusted_bytes: bool = False) -> "Commit":
+        # columnar fast path: one C call parses the whole signature list
+        # (csrc/commit_codec.inc); Python only materializes the objects.
+        # Falls through to the pure-Python walk when the native lib is
+        # absent or the wire shape needs its exact error behavior.
+        from ..crypto import native as _native
+
+        parsed = _native.commit_parse(buf) if _native.available() else None
+        if parsed is not None:
+            h_u64, r_u64, bid_span, cols = parsed
+            n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs, spans = cols
+            sig_list = []
+            spans_out = [] if trusted_bytes else None
+            flag_cache = _FLAG_CACHE
+            flag_of = BlockIDFlag
+            ts_of = Timestamp
+            cs_of = CommitSig
+            for i in range(n):
+                a0 = i * 20
+                s0 = i * 64
+                fv = flags[i]
+                sig_list.append(
+                    cs_of(
+                        flag_cache.get(fv) or flag_of(fv),
+                        addrs[a0 : a0 + addr_lens[i]],
+                        ts_of(ts_s[i], ts_n[i]),
+                        sigs[s0 : s0 + sig_lens[i]],
+                    )
+                )
+                if spans_out is not None:
+                    off = spans[2 * i]
+                    spans_out.append(buf[off : off + spans[2 * i + 1]])
+            bid_off, bid_len = bid_span
+            commit = cls(
+                pb.to_i64(h_u64),
+                pb.to_i64(r_u64),
+                BlockID.decode(buf[bid_off : bid_off + bid_len])
+                if bid_len or bid_off
+                else ZERO_BLOCK_ID,
+                sig_list,
+            )
+            if spans_out is not None:
+                commit.__dict__["_sig_spans"] = spans_out
+            return commit
         # specialized walk (one pass, no per-sig sub-buffer dicts): the
         # signature list dominates and replay decodes one commit per
         # block. trusted_bytes (store-loaded only) additionally stashes

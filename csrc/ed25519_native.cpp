@@ -1148,3 +1148,6 @@ void sha512_digest(const u8 *msg, u64 len, u8 *out) {
 
 // SHA-256 + RFC-6962 merkle root engine (own extern "C" exports)
 #include "merkle_native.inc"
+
+// Columnar Commit wire parser (own extern "C" exports)
+#include "commit_codec.inc"
