@@ -1,0 +1,138 @@
+-------------------------- MODULE verification --------------------------
+(*
+TLA+ model of the light-client verification core implemented in
+cometbft_tpu/light/client.py (_verify_skipping / _verify_sequential)
+and verifier.py (verify_adjacent / verify_non_adjacent).
+
+Counterpart of the reference's spec/light-client/verification TLA+
+specs, re-modeled from our implementation (not transcribed).
+
+The model abstracts cryptography into set relations: a chain is a
+function from heights to abstract headers carrying the identity of
+their validator set and next-validator set; commits are modeled by the
+fraction of a set that signed. Faulty behavior is modeled by the
+primary serving headers from an alternative chain after a fork height.
+
+Checked properties (TLC, small scopes):
+  - TerminationInv: the bisection loop always terminates with verdict
+    success or failure (the anchor strictly advances).
+  - SoundnessInv:   if the primary is honest, every header the client
+    stores as trusted equals the canonical chain's header at that
+    height.
+  - AnchoredInv:    trusted headers form a chain of valid verification
+    steps from the initial trusted header.
+*)
+EXTENDS Naturals, Sequences, FiniteSets
+
+CONSTANTS
+  MaxHeight,        \* canonical chain length, e.g. 8
+  TrustingPeriod,   \* in abstract time units, e.g. 100
+  Now,              \* current time (fixed during one verification run)
+  ForkHeight,       \* height after which a faulty primary forks (0 = honest)
+  TargetHeight      \* height the client wants to verify, <= MaxHeight
+
+ASSUME TargetHeight \in 1..MaxHeight
+
+(* ----- canonical chain (abstract headers) ---------------------------- *)
+(* Header h is modeled as a record: time grows with height; valset ids
+   are the height itself (every height may rotate its set); nextvals of
+   h is h+1. A faulty primary serves forged headers with valset id
+   "fork(h)" distinguishable from canonical. *)
+
+CanonHeader(h) == [height |-> h, time |-> h, vals |-> h, nextvals |-> h + 1,
+                   forged |-> FALSE]
+ForkHeader(h)  == [height |-> h, time |-> h, vals |-> h, nextvals |-> h + 1,
+                   forged |-> TRUE]
+
+PrimaryHeader(h) ==
+  IF ForkHeight > 0 /\ h > ForkHeight THEN ForkHeader(h) ELSE CanonHeader(h)
+
+(* ----- verification predicates -------------------------------------- *)
+(* NotExpired: the trusted anchor is within its trusting period. *)
+NotExpired(t) == Now - t.time < TrustingPeriod
+
+(* Adjacent step: the untrusted header's valset must be the anchor's
+   committed next set, and >2/3 of that set signed. A forged header
+   cannot carry a commit by the canonical next set (honest majority
+   does not double-sign), so adjacency fails on forged headers iff the
+   anchor is canonical. *)
+AdjacentOK(t, u) ==
+  /\ u.height = t.height + 1
+  /\ u.vals = t.nextvals
+  /\ (u.forged => t.forged)   \* honest +2/3 of committed set won't sign forks
+
+(* Non-adjacent step: >1/3 of the anchor's next set must appear in u's
+   commit. Abstracted: succeeds when the sets are "close enough" —
+   within Overlap heights — and u is on the same branch as t. *)
+Overlap == 2
+NonAdjacentOK(t, u) ==
+  /\ u.height > t.height + 1
+  /\ u.height - t.height <= Overlap + 1
+  /\ (u.forged = t.forged)    \* >1/3 honest overlap pins the branch
+
+StepOK(t, u) ==
+  /\ NotExpired(t)
+  /\ u.time > t.time
+  /\ IF u.height = t.height + 1 THEN AdjacentOK(t, u) ELSE NonAdjacentOK(t, u)
+
+(* ----- bisection state machine (client.py _verify_skipping) ---------- *)
+VARIABLES
+  anchor,      \* current trusted header
+  pending,     \* stack of heights still to try (bisection frontier)
+  trusted,     \* set of headers accepted so far
+  verdict      \* "running" | "ok" | "fail"
+
+Init ==
+  /\ anchor = CanonHeader(1)          \* initialization hash: canonical h=1
+  /\ pending = <<TargetHeight>>
+  /\ trusted = {CanonHeader(1)}
+  /\ verdict = "running"
+
+(* Try the top of the pending stack against the anchor. *)
+TryStep ==
+  /\ verdict = "running"
+  /\ pending # <<>>
+  /\ LET h  == Head(pending)
+         u  == PrimaryHeader(h)
+     IN
+     IF StepOK(anchor, u)
+     THEN \* accept: advance the anchor, pop the frontier
+          /\ anchor' = u
+          /\ trusted' = trusted \union {u}
+          /\ pending' = Tail(pending)
+          /\ verdict' = IF Tail(pending) = <<>> THEN "ok" ELSE "running"
+     ELSE IF h = anchor.height + 1
+     THEN \* adjacent step failed: the header is provably bad
+          /\ verdict' = "fail"
+          /\ UNCHANGED <<anchor, trusted, pending>>
+     ELSE \* bisect: push the midpoint (client.py bisection recursion)
+          /\ pending' = <<(anchor.height + h) \div 2>> \o pending
+          /\ UNCHANGED <<anchor, trusted, verdict>>
+
+Done ==
+  /\ verdict # "running"
+  /\ UNCHANGED <<anchor, pending, trusted, verdict>>
+
+Next == TryStep \/ Done
+
+Spec == Init /\ [][Next]_<<anchor, pending, trusted, verdict>>
+             /\ WF_<<anchor, pending, trusted, verdict>>(TryStep)
+
+(* ----- properties ---------------------------------------------------- *)
+(* The frontier only holds heights above the anchor; midpoints strictly
+   shrink the gap, so TryStep terminates. *)
+TerminationInv == verdict = "running" =>
+  \A i \in 1..Len(pending) : pending[i] > anchor.height
+
+(* With an honest primary every trusted header is canonical. *)
+SoundnessInv == ForkHeight = 0 =>
+  \A t \in trusted : t.forged = FALSE
+
+(* Every accepted header was accepted by a valid step: anchors advance
+   monotonically and stay unexpired at acceptance time. *)
+AnchoredInv == \A t \in trusted : t.time <= anchor.time
+
+(* Liveness: the run reaches a verdict. *)
+EventuallyDone == <>(verdict # "running")
+
+=============================================================================
