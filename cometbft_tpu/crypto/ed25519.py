@@ -43,20 +43,22 @@ BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
 # At and above this size the RLC/MSM engine (ops/msm.py) is considered
 # instead of the per-lane ladder kernel (one multi-scalar multiplication
 # instead of N ladders, reference crypto/ed25519/ed25519.go:207-240).
-# The engines trade differently: RLC needs ~4x fewer device field muls
-# (Pippenger buckets vs per-lane ladders) but ships ~110 B/lane (R +
-# the digit stream) where the ladder ships 96 (R||S||k) — so on a
-# bandwidth-starved host->device link (this tunnel: 26-50 MB/s) the
-# ladder wins, while on a PCIe-class link RLC wins by ~3x. The dispatch
-# measures the link once (_link_mbps) and picks by modeled time.
+# MEASURED head-to-head on the real chip (round 4, 10k batches, depth-8
+# pipeline): ladder 178k sigs/s, RLC 41.7k — despite ~7x fewer field
+# muls, Pippenger's per-round (2B+1)-entry niels gathers are
+# memory-bound on TPU while the ladder's 16-entry per-lane tables stay
+# regular, so the ladder wins by ~4x end-to-end (PROFILE.md). The
+# dispatch keeps the modeled-time comparison with the measured
+# constants: RLC only wins if a future kernel removes the gather wall.
 RLC_MIN = 4096
-_DEV_LADDER_US = 2.2   # measured device time per signature (PROFILE.md)
-_DEV_RLC_US = 0.7      # ~490 accumulate muls + decompress + reduce
-_WIRE_LADDER_B = 96    # R||S||k per lane
+_DEV_LADDER_US = 4.5   # measured e2e device time per signature (r4)
+_DEV_RLC_US = 24.0     # measured e2e (gather-bound accumulate kernel)
+_WIRE_LADDER_B = 96    # R||S||k per lane (73 on the delta fast path)
 # R (32) + A (32, re-shipped each submit: the RLC path keys its random
 # layout per batch, so there is no device-resident A cache analogue) +
-# ~39 digit-stream entries (~2.1 B) + counts
-_WIRE_RLC_B = 148
+# ~39 digit-stream entries (~2.1 B) + counts — measured 116 B/lane at
+# 10k (bench instrumentation)
+_WIRE_RLC_B = 116
 
 _LINK_MBPS: float | None = None
 

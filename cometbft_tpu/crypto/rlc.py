@@ -199,20 +199,31 @@ def prepare(items, skip: np.ndarray, bucket: int):
     sentinel = 2 * bucket
     wide = sentinel > 0x7FFF  # uint16 covers buckets <= 16383
     dt = np.uint32 if wide else np.uint16
-    stream = np.empty(len(pt_sorted) + 1, dt)
-    stream[:-1] = pt_sorted
-    stream[-1] = sentinel  # padding slots gather here (identity point)
+    # Pad the stream to a tiered length: the true contribution count
+    # varies with the batch's random z digits, and a distinct array
+    # length per batch would make jit compile the (multi-minute) MSM
+    # graph once PER BATCH instead of once per tier. 8192-entry tiers
+    # keep the variant count at ~1-2 per bucket for <=16 KiB of extra
+    # wire (~1.6 B/lane at 10k) — trailing slots hold the identity
+    # sentinel, which invalid gathers already target.
+    c_len = len(pt_sorted)
+    tier = 1 << 13
+    padded = ((c_len + 1 + tier - 1) // tier) * tier
+    stream = np.full(padded, sentinel, dt)
+    stream[:c_len] = pt_sorted
     # signs ride in a separate bit-packed array (the index may need the
-    # full 16 bits); one trailing 0 byte backs the padding slots
-    negbits = np.packbits(neg_sorted, bitorder="little")
-    stream_neg = np.zeros(len(negbits) + 1, np.uint8)
-    stream_neg[: len(negbits)] = negbits
+    # full 16 bits); pad bits are zero and only sentinel slots land on
+    # them. Packing over the full padded length covers every gatherable
+    # position, max (padded-1)>>3 = len-1.
+    neg_padded = np.zeros(padded, np.uint8)
+    neg_padded[:c_len] = neg_sorted
+    stream_neg = np.packbits(neg_padded, bitorder="little")
 
     from ..ops.curve import scalar_digits
 
     return {
-        "stream": stream,  # (C+1,) point indices, dense by lane
-        "stream_neg": stream_neg,  # bit-packed signs, same order
+        "stream": stream,  # (tiered,) point indices dense by lane, then sentinels
+        "stream_neg": stream_neg,  # bit-packed signs, same order, tiered/8 bytes
         "counts": counts,  # (WK,) contributions per lane
         "s_rounds": s_rounds,  # device round count (static per launch)
         "weights": weight_table,  # (W, K) per-lane digit values

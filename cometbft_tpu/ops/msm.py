@@ -356,9 +356,12 @@ def expand_stream(stream, stream_neg, counts, s_rounds):
 
     The host ships ~2 bytes per contribution (crypto/rlc.py); the
     padded per-round table the accumulate kernel wants is rebuilt here
-    with a cumsum + masked take, so the wire never carries sentinel
-    padding. stream: (C+1,) uint16/uint32, last entry = identity
-    sentinel; stream_neg: bit-packed signs (+1 pad byte); counts: (WK,).
+    with a cumsum + masked take. stream: (L,) uint16/uint32 where L is
+    tier-padded to a multiple of 8192 (stable jit shapes across the
+    per-batch random layouts): the first C entries are the dense
+    contributions, every trailing slot holds the identity sentinel, and
+    invalid gathers target L-1; stream_neg: bit-packed signs over the
+    full padded length (L/8 bytes); counts: (WK,) — sum(counts) = C.
     """
     counts = counts.astype(jnp.int32)
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix

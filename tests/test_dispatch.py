@@ -301,3 +301,21 @@ def test_delta_detection_ragged_lengths(monkeypatch):
         bv.add(e.Ed25519PubKey(p), m, s)
     ok, bits = bv.submit().result()
     assert ok and all(bits)
+
+
+def test_rlc_stream_length_is_tiered():
+    """The wire stream must be padded to a coarse length tier: its true
+    length varies with each batch's random z digits, and a distinct jit
+    input shape per batch would recompile the multi-minute MSM graph
+    once per submit instead of once per tier."""
+    from cometbft_tpu.crypto import rlc
+
+    lengths = set()
+    for _ in range(3):  # each prepare() draws a fresh random layout
+        items = _signed(64)
+        prep = rlc.prepare(items, np.zeros(64, bool), 64)
+        assert len(prep["stream"]) % (1 << 13) == 0
+        # sign array covers every gatherable position incl. the sentinel
+        assert len(prep["stream_neg"]) * 8 >= len(prep["stream"])
+        lengths.add(len(prep["stream"]))
+    assert len(lengths) == 1, "same-size batches must share one tier"
