@@ -246,6 +246,11 @@ class ReplayEngine:
                 state.last_block_id, state.initial_height, blocks,
             )
             while blocks:
+                # start the (fixed ~100 ms through a tunnel) device->host
+                # fetch of this window's verdict now, so it rides under
+                # the next window's load + sign-bytes packing instead of
+                # blocking in _resolve_window
+                handle[0].prefetch()
                 nh = blocks[-1].header.height + 1
                 nxt = nxt_handle = None
                 if nh <= tip:
