@@ -107,6 +107,23 @@ NATIVE_MAX = 1024
 DELTA_MIN = 256
 DELTA_MAX_BUCKET = 16384
 
+# Measured end-to-end per-sig times (round 4, 10k batches, depth-16
+# pipeline): the delta path ships 23 fewer bytes/lane but pays device
+# SHA-512 + reduce512 for every lane, and on this chip that costs more
+# than the wire it saves (260k vs 194k sigs/s prehashed-vs-delta). The
+# dispatch picks by modeled time against the probed link: delta only
+# wins below ~19 MB/s.
+_DEV_DELTA_US = 5.1     # device rebuild + hash + ladder, e2e per sig
+_DEV_PREHASH_US = 3.8   # host-hashed k, ladder only, e2e per sig
+_WIRE_DELTA_B = 73
+
+
+def _delta_beats_prehashed(n: int, b: int) -> bool:
+    bw = _link_mbps() * 1e6
+    t_delta = max(_WIRE_DELTA_B * b / bw, n * _DEV_DELTA_US * 1e-6)
+    t_pre = max(_WIRE_LADDER_B * b / bw, n * _DEV_PREHASH_US * 1e-6)
+    return t_delta < t_pre
+
 
 class Ed25519PubKey(PubKey):
     __slots__ = ("_b",)
@@ -370,7 +387,11 @@ class Ed25519BatchVerifier(BatchVerifier):
         # the vote timestamp), ship R||S + the per-lane delta and rebuild
         # + hash the messages on device — fewer wire bytes per lane than
         # the 96-byte R||S||k path on a bandwidth-limited link
-        if DELTA_MIN <= n and b <= DELTA_MAX_BUCKET:
+        if (
+            DELTA_MIN <= n
+            and b <= DELTA_MAX_BUCKET
+            and _delta_beats_prehashed(n, b)
+        ):
             if self._delta is None:
                 self._delta = _detect_delta(self._items) or False
             if self._delta:

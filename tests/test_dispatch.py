@@ -245,6 +245,9 @@ def test_delta_wire_path_end_to_end(monkeypatch):
 
     monkeypatch.setattr(e, "NATIVE_MAX", 0)
     monkeypatch.setattr(e, "DELTA_MIN", 1)
+    # pin the wire-format choice: this test exercises the delta path
+    # itself, not the measured-time dispatch between delta/prehashed
+    monkeypatch.setattr(e, "_delta_beats_prehashed", lambda n, b: True)
     pfx = b"\x08\x02\x11" + bytes(range(60))  # vote-ish shared prefix
     sfx = b"2\x0bbench-chain"
     items = []
