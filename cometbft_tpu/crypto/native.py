@@ -69,50 +69,66 @@ def get_lib():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        lib.ed25519_verify.restype = ctypes.c_int
-        lib.ed25519_verify.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
-        ]
-        lib.ed25519_sign.restype = None
-        lib.ed25519_sign.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_uint64, ctypes.c_char_p,
-        ]
-        lib.ed25519_pubkey.restype = None
-        lib.ed25519_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
-        lib.ed25519_batch_verify.restype = ctypes.c_int
-        lib.ed25519_batch_verify.argtypes = [
-            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
-        ]
-        lib.ed25519_engine.restype = ctypes.c_int
-        lib.ed25519_engine.argtypes = []
-        lib.merkle_root_native.restype = None
-        lib.merkle_root_native.argtypes = [
-            ctypes.c_uint64, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
-        ]
-        lib.sha256_oneshot.restype = None
-        lib.sha256_oneshot.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
-        ]
-        lib.sha256_engine.restype = ctypes.c_int
-        lib.sha256_engine.argtypes = []
-        lib.sha256_force_portable.restype = None
-        lib.sha256_force_portable.argtypes = [ctypes.c_int]
-        lib.commit_parse.restype = ctypes.c_long
-        lib.commit_parse.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64),                    # head
-            ctypes.c_char_p,                                    # flags
-            ctypes.c_char_p, ctypes.c_char_p,                   # addr_lens, addrs
-            ctypes.POINTER(ctypes.c_int64),                     # ts_s
-            ctypes.POINTER(ctypes.c_int64),                     # ts_n
-            ctypes.c_char_p, ctypes.c_char_p,                   # sig_lens, sigs
-            ctypes.POINTER(ctypes.c_uint64),                    # spans
-        ]
+        try:
+            _bind(lib)
+        except AttributeError:
+            # a stale prebuilt .so missing newer symbols (shipped without
+            # the csrc tree, so the mtime rebuild guard never fires):
+            # degrade to the pure-Python paths rather than crash the hot
+            # submit path — "every entry point degrades gracefully"
+            return None
         _lib = lib
         return _lib
+
+
+def _bind(lib) -> None:
+    lib.ed25519_verify.restype = ctypes.c_int
+    lib.ed25519_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.ed25519_sign.restype = None
+    lib.ed25519_sign.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.ed25519_pubkey.restype = None
+    lib.ed25519_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ed25519_batch_verify.restype = ctypes.c_int
+    lib.ed25519_batch_verify.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+    ]
+    lib.ed25519_engine.restype = ctypes.c_int
+    lib.ed25519_engine.argtypes = []
+    lib.merkle_root_native.restype = None
+    lib.merkle_root_native.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+    ]
+    lib.sha256_oneshot.restype = None
+    lib.sha256_oneshot.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.sha256_engine.restype = ctypes.c_int
+    lib.sha256_engine.argtypes = []
+    lib.sha256_force_portable.restype = None
+    lib.sha256_force_portable.argtypes = [ctypes.c_int]
+    lib.ed25519_batch_k.restype = None
+    lib.ed25519_batch_k.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+    ]
+    lib.commit_parse.restype = ctypes.c_long
+    lib.commit_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),                    # head
+        ctypes.c_char_p,                                    # flags
+        ctypes.c_char_p, ctypes.c_char_p,                   # addr_lens, addrs
+        ctypes.POINTER(ctypes.c_int64),                     # ts_s
+        ctypes.POINTER(ctypes.c_int64),                     # ts_n
+        ctypes.c_char_p, ctypes.c_char_p,                   # sig_lens, sigs
+        ctypes.POINTER(ctypes.c_uint64),                    # spans
+    ]
 
 
 def engine() -> str:
@@ -162,6 +178,27 @@ def batch_verify(items) -> bool:
     msgs = b"".join(it[1] for it in items)
     lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
     return bool(lib.ed25519_batch_verify(n, pubs, msgs, lens, sigs))
+
+
+def batch_challenge_scalars(
+    items, sig_blob: bytes | None = None, pub_blob: bytes | None = None
+) -> bytes | None:
+    """k_i = SHA-512(R_i || A_i || M_i) mod L for every (pub, msg, sig)
+    triple, concatenated 32-byte little-endian scalars; None when the
+    native lib is absent (caller hashes via hashlib). Callers that
+    already hold the concatenated signature/pubkey blobs (the device
+    packers do) pass them to skip re-joining."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(items)
+    sigs = sig_blob if sig_blob is not None else b"".join(it[2] for it in items)
+    pubs = pub_blob if pub_blob is not None else b"".join(it[0] for it in items)
+    msgs = b"".join(it[1] for it in items)
+    lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
+    out = ctypes.create_string_buffer(n * 32)
+    lib.ed25519_batch_k(n, sigs, pubs, msgs, lens, out)
+    return out.raw
 
 
 def commit_parse(buf: bytes):

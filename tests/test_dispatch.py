@@ -291,6 +291,7 @@ def test_delta_detection_ragged_lengths(monkeypatch):
 
     monkeypatch.setattr(e, "NATIVE_MAX", 0)
     monkeypatch.setattr(e, "DELTA_MIN", 1)
+    monkeypatch.setattr(e, "_delta_beats_prehashed", lambda n, b: True)
     pfx = bytes(rng.bytes(70))
     sfx = bytes(rng.bytes(14))
     items = []
