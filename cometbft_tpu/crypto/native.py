@@ -28,6 +28,7 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "ed25519_ifma.inc"),
     os.path.join(os.path.dirname(_SRC), "merkle_native.inc"),
     os.path.join(os.path.dirname(_SRC), "commit_codec.inc"),
+    os.path.join(os.path.dirname(_SRC), "sha512_mb.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 

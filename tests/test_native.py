@@ -63,3 +63,30 @@ def test_key_classes_use_native():
     assert not pk.pub_key().verify_signature(b"votes", sig)
     # deterministic: matches the oracle exactly
     assert sig == ref.sign(b"\x04" * 32, b"vote")
+
+
+def test_batch_challenge_scalars_differential():
+    """The C batch k = SHA-512(R||A||M) mod L (8-way AVX-512 multi-buffer
+    with scalar fallback for ragged groups) must match hashlib exactly —
+    over uniform lengths (full 8-groups), ragged lengths (fallback), and
+    block-boundary sizes (111/112 flip one-block/two-block padding)."""
+    import hashlib
+    import random
+
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.crypto import native
+
+    rng = random.Random(11)
+    items = []
+    for ln in [0, 1, 47, 63, 64, 100, 100, 100, 100, 100, 100, 100, 100,
+               111, 112, 127, 128, 300, 1000]:
+        seed = rng.randbytes(32)
+        msg = rng.randbytes(ln)
+        items.append((ref.pubkey_from_seed(seed), msg, ref.sign(seed, msg)))
+    ks = native.batch_challenge_scalars(items)
+    for i, (pub, msg, sig) in enumerate(items):
+        want = (
+            int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little")
+            % ref.L
+        ).to_bytes(32, "little")
+        assert ks[i * 32 : (i + 1) * 32] == want, (i, len(msg))
