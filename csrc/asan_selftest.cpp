@@ -25,6 +25,126 @@ int ed25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
 void ed25519_sign(const u8 *seed, const u8 *pub, const u8 *msg, u64 msg_len,
                   u8 *sig_out);
 void ed25519_pubkey(const u8 *seed, u8 *pub_out);
+void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
+                     const u64 *msg_lens, u8 *out);
+void merkle_root_native(u64 n, const u8 *blob, const u64 *offs, u8 *out32);
+void sha256_oneshot(const u8 *data, u64 len, u8 *out32);
+long commit_parse(const u8 *buf, u64 len, u64 cap, u64 *head, u8 *flags,
+                  u8 *addr_lens, u8 *addrs, int64_t *ts_s, int64_t *ts_n,
+                  u8 *sig_lens, u8 *sigs, u64 *spans);
+}
+
+// deterministic PRNG for the fuzz loops (no OS entropy in the harness)
+static u64 lcg_state = 0x243F6A8885A308D3ULL;
+static u8 lcg() {
+    lcg_state = lcg_state * 6364136223846793005ULL + 1442695040888963407ULL;
+    return (u8)(lcg_state >> 56);
+}
+
+// run commit_parse with tightly-sized heap buffers so ASAN catches any
+// out-of-bounds write; result value is irrelevant (parse-or-reject)
+static void parse_once(const u8 *buf, u64 len) {
+    u64 cap = len / 6 + 4;
+    u64 head[4];
+    std::vector<u8> flags(cap), addr_lens(cap), addrs(cap * 20);
+    std::vector<int64_t> ts_s(cap), ts_n(cap);
+    std::vector<u8> sig_lens(cap), sigs_out(cap * 64);
+    std::vector<u64> spans(cap * 2);
+    long rc = commit_parse(buf, len, cap, head, flags.data(),
+                           addr_lens.data(), addrs.data(), ts_s.data(),
+                           ts_n.data(), sig_lens.data(), sigs_out.data(),
+                           spans.data());
+    (void)rc;
+}
+
+static int new_surface_checks() {
+    // --- merkle + sha256: ragged leaves incl. empty, vs double hashing
+    {
+        std::vector<u8> blob;
+        std::vector<u64> offs;
+        offs.push_back(0);
+        for (int i = 0; i < 100; i++) {
+            u64 ln = (u64)(i % 7) * 31;
+            for (u64 b = 0; b < ln; b++) blob.push_back(lcg());
+            offs.push_back(blob.size());
+        }
+        u8 root[32], root2[32];
+        merkle_root_native(100, blob.data(), offs.data(), root);
+        merkle_root_native(100, blob.data(), offs.data(), root2);
+        if (memcmp(root, root2, 32) != 0) {
+            printf("FAIL: merkle root not deterministic\n");
+            return 1;
+        }
+        merkle_root_native(0, nullptr, offs.data(), root);  // empty tree
+        u8 d[32];
+        sha256_oneshot(blob.data(), blob.size(), d);
+        sha256_oneshot(nullptr, 0, d);
+    }
+    // --- batch_k: uniform (8-way multibuffer) + ragged (scalar) groups
+    {
+        const int N = 21;
+        std::vector<u8> pubs(N * 32), sigs(N * 64), msgs;
+        std::vector<u64> lens(N);
+        for (int i = 0; i < N; i++) {
+            for (int b = 0; b < 32; b++) pubs[i * 32 + b] = lcg();
+            for (int b = 0; b < 64; b++) sigs[i * 64 + b] = lcg();
+            u64 ln = (i < 16) ? 100 : (u64)(i % 5) * 53;
+            lens[i] = ln;
+            for (u64 b = 0; b < ln; b++) msgs.push_back(lcg());
+        }
+        std::vector<u8> out(N * 32);
+        ed25519_batch_k(N, sigs.data(), pubs.data(), msgs.data(),
+                        lens.data(), out.data());
+    }
+    // --- commit_parse: synthesized valid-ish wire, then mutation fuzz
+    {
+        std::vector<u8> wire;
+        auto put_varint = [&](u64 v) {
+            while (v >= 0x80) { wire.push_back((u8)(v | 0x80)); v >>= 7; }
+            wire.push_back((u8)v);
+        };
+        put_varint((1 << 3) | 0); put_varint(7);    // height
+        put_varint((2 << 3) | 0); put_varint(1);    // round
+        for (int i = 0; i < 10; i++) {              // 10 CommitSigs
+            std::vector<u8> sigbody;
+            auto put_inner = [&](u64 v) {
+                while (v >= 0x80) { sigbody.push_back((u8)(v | 0x80)); v >>= 7; }
+                sigbody.push_back((u8)v);
+            };
+            put_inner((1 << 3) | 0); put_inner(2);           // flag COMMIT
+            put_inner((2 << 3) | 2); put_inner(20);          // addr
+            for (int b = 0; b < 20; b++) sigbody.push_back(lcg());
+            put_inner((3 << 3) | 2); put_inner(4);           // ts
+            put_inner((1 << 3) | 0); put_inner(1700000000u & 0x7f);
+            put_inner((2 << 3) | 0); put_inner(5);
+            put_inner((4 << 3) | 2); put_inner(64);          // sig
+            for (int b = 0; b < 64; b++) sigbody.push_back(lcg());
+            put_varint((4 << 3) | 2);
+            put_varint(sigbody.size());
+            wire.insert(wire.end(), sigbody.begin(), sigbody.end());
+        }
+        parse_once(wire.data(), wire.size());
+        // truncations at every boundary
+        for (u64 cut = 0; cut <= wire.size(); cut += 3)
+            parse_once(wire.data(), cut);
+        // random mutations
+        std::vector<u8> mut = wire;
+        for (int round_ = 0; round_ < 5000; round_++) {
+            mut = wire;
+            int flips = 1 + (lcg() % 6);
+            for (int f = 0; f < flips; f++)
+                mut[lcg_state % mut.size()] = lcg();
+            parse_once(mut.data(), mut.size());
+        }
+        // pure garbage
+        std::vector<u8> junk(257);
+        for (int round_ = 0; round_ < 2000; round_++) {
+            for (auto &b : junk) b = lcg();
+            parse_once(junk.data(), 1 + (lcg_state % junk.size()));
+        }
+    }
+    printf("asan new-surface checks ok (merkle, batch_k, commit_parse fuzz)\n");
+    return 0;
 }
 
 int main() {
@@ -67,6 +187,7 @@ int main() {
         printf("FAIL: junk accepted\n");
         return 1;
     }
+    if (new_surface_checks() != 0) return 1;
     printf("asan selftest ok (%d signatures, threaded batch)\n", N);
     return 0;
 }
