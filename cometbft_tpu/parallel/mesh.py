@@ -27,23 +27,43 @@ def make_mesh(devices=None, axis: str = "sig") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
-def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
-    """Build a pjit-ed batched verifier sharded over `axis`.
+def make_mesh_2d(devices=None, hosts: int = 2) -> Mesh:
+    """Hierarchical (host, sig) mesh for multi-host pods: the outer axis
+    maps to hosts (collectives cross DCN), the inner to the chips of one
+    host (collectives ride ICI). Lay out the batch over BOTH axes and
+    reduce hierarchically so only one scalar per host crosses DCN — the
+    layout discipline from the scaling playbook (slow axis outermost)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n % hosts:
+        raise ValueError(f"{n} devices do not split over {hosts} hosts")
+    return Mesh(
+        np.asarray(devices).reshape(hosts, n // hosts), ("host", "sig")
+    )
+
+
+def sharded_verify_fn(mesh: Mesh, axes: str | tuple[str, ...] = "sig"):
+    """Build a pjit-ed batched verifier sharded over one or more mesh axes.
 
     Inputs: a_bytes (B,32)u8, r_bytes (B,32)u8, s_bytes (B,32)u8,
     msg_words (B,64)u32, two_blocks (B,)bool, live (B,)bool; B must divide
-    by mesh size.
+    by the product of the named mesh axes.
     Returns (all_ok: bool scalar replicated, bits: (B,) bool sharded).
+
+    The invalid-lane count psums over the axes INNERMOST-FIRST: on a
+    hierarchical (host, sig) mesh the partial sums ride ICI within each
+    host and only one scalar per host crosses DCN.
     """
+    axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
 
     def local(a, r, s, m, tb, live):
         bits, _ = ed25519_verify.verify_batch(a, r, s, m, tb, live)
-        # all-valid = "no live lane failed"; single psum over ICI.
         bad = jnp.sum((~bits & live).astype(jnp.int32))
-        total_bad = jax.lax.psum(bad, axis)
-        return total_bad == 0, bits
+        for ax in reversed(axes_t):  # innermost (fast) axis first
+            bad = jax.lax.psum(bad, ax)
+        return bad == 0, bits
 
-    spec_b = P(axis)
+    spec_b = P(axes_t if len(axes_t) > 1 else axes_t[0])
     fn = shard_map(
         local,
         mesh=mesh,
@@ -52,3 +72,10 @@ def sharded_verify_fn(mesh: Mesh, axis: str = "sig"):
         check_rep=False,
     )
     return jax.jit(fn)
+
+
+def sharded_verify_fn_2d(mesh: Mesh):
+    """Verifier over a (host, sig) mesh (make_mesh_2d): batch sharded
+    across every chip of every host, hierarchical reduction (see
+    sharded_verify_fn)."""
+    return sharded_verify_fn(mesh, axes=("host", "sig"))

@@ -1,0 +1,71 @@
+"""Device-mesh sharding of the signature data plane (SURVEY §2.15/§5.7:
+the batch axis is our data-parallel dimension; psum over ICI reduces the
+commit-accept bit). Runs on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def _batch(n):
+    import __graft_entry__ as g
+
+    return g._example_batch(n)
+
+
+def test_sharded_verify_1d_and_2d_agree():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cometbft_tpu.parallel.mesh import (
+        make_mesh,
+        make_mesh_2d,
+        sharded_verify_fn,
+        sharded_verify_fn_2d,
+    )
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("needs 8 virtual devices")
+    raw = _batch(64)
+
+    mesh = make_mesh(cpus[:8])
+    fn = sharded_verify_fn(mesh)
+    args = [jax.device_put(a, NamedSharding(mesh, P("sig"))) for a in raw]
+    ok1, bits1 = jax.block_until_ready(fn(*args))
+
+    mesh2 = make_mesh_2d(cpus[:8], hosts=2)
+    fn2 = sharded_verify_fn_2d(mesh2)
+    args2 = [
+        jax.device_put(a, NamedSharding(mesh2, P(("host", "sig"))))
+        for a in raw
+    ]
+    ok2, bits2 = jax.block_until_ready(fn2(*args2))
+
+    assert bool(ok1) and bool(ok2)
+    assert np.asarray(bits1).all() and np.asarray(bits2).all()
+
+    # flip one signature byte: BOTH layouts must reject, and the psum'd
+    # verdict must reflect the single bad lane on whichever shard holds it
+    bad = [np.array(a, copy=True) for a in raw]
+    bad[2][17, 0] ^= 1  # s_raw of lane 17
+    argsb = [jax.device_put(a, NamedSharding(mesh, P("sig"))) for a in bad]
+    okb, bitsb = jax.block_until_ready(fn(*argsb))
+    args2b = [
+        jax.device_put(a, NamedSharding(mesh2, P(("host", "sig"))))
+        for a in bad
+    ]
+    ok2b, bits2b = jax.block_until_ready(fn2(*args2b))
+    assert not bool(okb) and not bool(ok2b)
+    assert not np.asarray(bitsb)[17] and not np.asarray(bits2b)[17]
+    assert np.asarray(bitsb).sum() == 63 and np.asarray(bits2b).sum() == 63
+
+
+def test_mesh_2d_shape_validation():
+    from cometbft_tpu.parallel.mesh import make_mesh_2d
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("needs 8 virtual devices")
+    with pytest.raises(ValueError):
+        make_mesh_2d(cpus[:7], hosts=2)
