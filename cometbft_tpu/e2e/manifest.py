@@ -26,11 +26,13 @@ class Perturbation:
     stop + start), pause (SIGSTOP for `down_s`, then SIGCONT),
     partition (transport-level frame drop from every other node for
     `down_s`, then heal — reference test/e2e/runner/perturb.go:31-90's
-    disconnect class, without needing network namespaces).
+    disconnect class, without needing network namespaces), upgrade
+    (graceful restart advertising a bumped software version — the
+    reference's binary-swap class; `down_s` unused).
     """
 
     node: str
-    op: str  # kill | restart | pause | partition
+    op: str  # kill | restart | pause | partition | upgrade
     at_height: int
     down_s: float = 2.0
 
@@ -74,13 +76,16 @@ def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
         NodeSpec(name=f"node{i}", power=rng.choice([10, 10, 20]))
         for i in range(n_nodes)
     ]
-    ops = ["kill", "restart", "pause", "partition"]
+    ops = ["kill", "restart", "pause", "partition", "upgrade"]
     perturbations = []
     # 1-2 perturbations at distinct heights, never two on one node at
     # the same height; partitions only make sense with >= 3 nodes (a
-    # 2-node net cannot commit during one and merely stalls)
+    # 2-node net cannot commit during one and merely stalls) — every
+    # other op, upgrade included, is safe at any size
     for k in range(rng.choice([1, 2])):
-        op = rng.choice(ops if n_nodes >= 3 else ops[:3])
+        op = rng.choice(
+            ops if n_nodes >= 3 else [o for o in ops if o != "partition"]
+        )
         perturbations.append(
             Perturbation(
                 node=f"node{rng.randrange(n_nodes)}",

@@ -52,13 +52,20 @@ class _ProcNode:
         self.rpc_port = rpc_port
         self.proc: subprocess.Popen | None = None
         self.log = open(os.path.join(home, "node.log"), "ab")
+        # per-node env overrides applied at (re)start — the "upgrade"
+        # perturbation restarts a node as a newer build via
+        # COMETBFT_TPU_VERSION
+        self.extra_env: dict[str, str] = {}
 
     def start(self) -> None:
+        if self.log.closed:  # relaunch after stop_all closed the log
+            self.log = open(os.path.join(self.home, "node.log"), "ab")
         env = dict(os.environ)
         # subprocess nodes run the CPU backend: many processes sharing
         # one test machine must not all grab the accelerator
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
+        env.update(self.extra_env)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "cometbft_tpu.cli",
              "--home", self.home, "start"],
@@ -307,6 +314,14 @@ class Runner:
             self._partition(p.node, True)
             time.sleep(p.down_s)
             self._partition(p.node, False)
+        elif p.op == "upgrade":
+            # restart as a newer build (reference perturb.go's binary
+            # swap): the node comes back advertising a bumped software
+            # version and must keep interoperating with the old-version
+            # peers — NodeInfo compatibility is network+channels only
+            node.stop()
+            node.extra_env["COMETBFT_TPU_VERSION"] = "99.0.0-e2e-upgrade"
+            node.start()
         else:
             raise E2EError(f"unknown perturbation op {p.op!r}")
 

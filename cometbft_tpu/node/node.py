@@ -160,10 +160,17 @@ class Node:
         )
 
         # --- p2p -------------------------------------------------------
+        from .. import __version__
+
         info = NodeInfo(
             node_id=self.node_key.node_id(),
             network=self.genesis_doc.chain_id,
             moniker=config.base.moniker,
+            # software version is informational (compatible_with checks
+            # network + channels only); the env override is the e2e
+            # "upgrade" perturbation's hook for restarting a node as a
+            # newer build (reference test/e2e/runner/perturb.go upgrade)
+            version=os.environ.get("COMETBFT_TPU_VERSION", __version__),
         )
         self.transport = Transport(self.node_key, info)
         self.switch = Switch(

@@ -126,6 +126,7 @@ def status(env, params):
             "id": env.node_info.node_id if env.node_info else "",
             "network": env.genesis_doc.chain_id if env.genesis_doc else "",
             "moniker": env.node_info.moniker if env.node_info else "",
+            "version": env.node_info.version if env.node_info else "",
         },
         "sync_info": {
             "latest_block_height": str(latest),
