@@ -19,7 +19,7 @@ import os
 import subprocess
 import threading
 
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc",
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc",
                     "ed25519_native.cpp")
 # sources whose edits must trigger a rebuild (the .cpp includes the
 # IFMA engine from the .inc)

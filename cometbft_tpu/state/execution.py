@@ -372,6 +372,8 @@ class BlockExecutor:
         # private .copy() first, so ValidatorSet objects reachable from
         # a State are never mutated in place — sharing them across the
         # rotation is safe and saves 2 full-set copies per block
+        # (State.__post_init__ freezes the sets so a violation of that
+        # convention raises instead of corrupting historical sets)
         return replace(
             state,
             last_block_height=block.header.height,

@@ -165,6 +165,17 @@ class State:
     last_results_hash: bytes = b""
     app_hash: bytes = b""
 
+    def __post_init__(self):
+        # State snapshots alias ValidatorSet objects (no defensive
+        # copies); the convention is that every mutator works on a
+        # private .copy(). Freezing here — the single choke point every
+        # producer passes through (decode, statesync, rollback, genesis,
+        # dataclasses.replace) — makes a violation fail loudly instead
+        # of silently corrupting historical sets.
+        for vs in (self.validators, self.last_validators, self.next_validators):
+            if vs is not None:
+                vs.freeze()
+
     def copy(self) -> "State":
         return replace(self)
 

@@ -383,9 +383,12 @@ class Commit:
                 a0 = i * 20
                 s0 = i * 64
                 fv = flags[i]
+                fl = flag_cache.get(fv)
+                if fl is None:  # UNKNOWN(0) is falsy; don't use `or`
+                    fl = flag_of(fv)
                 sig_list.append(
                     cs_of(
-                        flag_cache.get(fv) or flag_of(fv),
+                        fl,
                         addrs[a0 : a0 + addr_lens[i]],
                         ts_of(ts_s[i], ts_n[i]),
                         sigs[s0 : s0 + sig_lens[i]],

@@ -111,6 +111,7 @@ class ValidatorSet:
         self.proposer: Validator | None = None
         self._total_power: int | None = None
         self._addr_index: dict[bytes, int] | None = None
+        self._frozen = False
         self.total_voting_power()  # validates the cap
         if increment_first:
             self.increment_proposer_priority(1)
@@ -161,12 +162,29 @@ class ValidatorSet:
             self.__dict__["_hash_memo"] = h
         return h
 
+    def freeze(self) -> "ValidatorSet":
+        """Seal the set against mutation. State snapshots share (alias)
+        ValidatorSet objects instead of defensively copying; the safety
+        convention is that every mutator operates on a private .copy()
+        first. freeze() makes a convention violation fail loudly instead
+        of silently corrupting historical sets."""
+        self._frozen = True
+        return self
+
+    def _assert_mutable(self):
+        if getattr(self, "_frozen", False):
+            raise RuntimeError(
+                "mutating a frozen ValidatorSet (aliased by a State "
+                "snapshot) — call .copy() first"
+            )
+
     def copy(self) -> "ValidatorSet":
         vs = ValidatorSet.__new__(ValidatorSet)
         vs.validators = [v.copy() for v in self.validators]
         vs.proposer = self.proposer.copy() if self.proposer else None
         vs._total_power = self._total_power
         vs._addr_index = None
+        vs._frozen = False
         memo = self.__dict__.get("_hash_memo")
         if memo is not None:  # same membership -> same hash
             vs.__dict__["_hash_memo"] = memo
@@ -186,6 +204,7 @@ class ValidatorSet:
             v.proposer_priority = _clip(v.proposer_priority - avg)
 
     def rescale_priorities(self, diff_max: int):
+        self._assert_mutable()
         if diff_max <= 0:
             return
         prios = [v.proposer_priority for v in self.validators]
@@ -209,6 +228,7 @@ class ValidatorSet:
         return mostest
 
     def increment_proposer_priority(self, times: int):
+        self._assert_mutable()
         if times <= 0:
             raise ValueError("times must be positive")
         diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
@@ -246,6 +266,7 @@ class ValidatorSet:
         :423-455, computeNewPriorities :479); priorities are then rescaled
         into the window and recentered, in that order (:638-639).
         """
+        self._assert_mutable()
         if not changes:
             return
         by_addr = {}

@@ -1,0 +1,156 @@
+"""Differential fuzz: native columnar Commit parser vs pure-Python walk.
+
+The native parser (cometbft_tpu/csrc/commit_codec.inc) decodes untrusted
+peer bytes whenever the native lib is present; the pure-Python decoder
+runs everywhere else. If the two ever diverge on ANY input, native and
+non-native builds split consensus. This test drives Commit.decode with
+the native path allowed and forced off over valid round-trips, random
+mutations, truncations, and garbage, asserting both sides either raise
+or produce identical commits AND identical hashes.
+
+(Reference analogue: the e2e app-hash cross-checks in
+test/e2e/runner/evidence.go catch decoder splits only after the fact;
+this checks the codec pair directly.)
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+from unittest import mock
+
+from cometbft_tpu.crypto import native
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+from cometbft_tpu.types.block import BlockIDFlag, Commit, CommitSig
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable (no divergence possible)"
+)
+
+
+def _decode_python(buf: bytes, trusted: bool):
+    with mock.patch.object(native, "available", return_value=False):
+        return Commit.decode(buf, trusted_bytes=trusted)
+
+
+def _decode_native(buf: bytes, trusted: bool):
+    assert native.available()
+    return Commit.decode(buf, trusted_bytes=trusted)
+
+
+def _both(buf: bytes, trusted: bool = False):
+    """Decode both ways; assert identical outcome. Returns the commit
+    pair on success, None if both raised."""
+    try:
+        py = _decode_python(buf, trusted)
+        py_err = None
+    except Exception as e:  # noqa: BLE001 — any decode error counts
+        py, py_err = None, type(e)
+    try:
+        nat = _decode_native(buf, trusted)
+        nat_err = None
+    except Exception as e:  # noqa: BLE001
+        nat, nat_err = None, type(e)
+    if (py_err is None) != (nat_err is None):
+        raise AssertionError(
+            f"decoder split: python={py_err or 'ok'} native={nat_err or 'ok'} "
+            f"buf={buf.hex()}"
+        )
+    if py is None:
+        return None
+    assert py.height == nat.height, buf.hex()
+    assert py.round == nat.round, buf.hex()
+    assert py.block_id == nat.block_id, buf.hex()
+    assert py.signatures == nat.signatures, buf.hex()
+    assert py.hash() == nat.hash(), buf.hex()
+    return py, nat
+
+
+def _rand_commit(rng: random.Random) -> Commit:
+    n = rng.randrange(0, 8)
+    sigs = []
+    for _ in range(n):
+        flag = rng.choice(list(BlockIDFlag))
+        if flag == BlockIDFlag.ABSENT and rng.random() < 0.7:
+            sigs.append(CommitSig.absent())
+            continue
+        sigs.append(
+            CommitSig(
+                block_id_flag=flag,
+                validator_address=rng.randbytes(rng.choice([0, 20, 20, 20, 5])),
+                timestamp=Timestamp(
+                    rng.choice([0, -1, 1_700_000_000, 2**40]),
+                    rng.choice([0, 1, 999_999_999]),
+                ),
+                signature=rng.randbytes(rng.choice([0, 64, 64, 64, 32])),
+            )
+        )
+    bid = rng.choice(
+        [
+            BlockID(),
+            BlockID(rng.randbytes(32), PartSetHeader(rng.randrange(4), rng.randbytes(32))),
+        ]
+    )
+    return Commit(
+        height=rng.choice([0, 1, rng.randrange(1, 2**62)]),
+        round=rng.choice([0, rng.randrange(0, 100)]),
+        block_id=bid,
+        signatures=sigs,
+    )
+
+
+def test_valid_roundtrips_agree():
+    rng = random.Random(0x5EED)
+    for _ in range(400):
+        c = _rand_commit(rng)
+        buf = c.encode()
+        pair = _both(buf)
+        assert pair is not None, "valid encoding must decode on both paths"
+        assert pair[0].height == c.height
+        # trusted_bytes path additionally pins the span-based hash
+        _both(buf, trusted=True)
+
+
+def test_mutations_agree():
+    rng = random.Random(0xF00D)
+    splits = 0
+    for _ in range(300):
+        buf = bytearray(_rand_commit(rng).encode())
+        if not buf:
+            continue
+        for _ in range(rng.randrange(1, 4)):
+            i = rng.randrange(len(buf))
+            buf[i] = rng.randrange(256)
+        _both(bytes(buf))
+        splits += 1
+    assert splits > 0
+
+
+def test_truncations_agree():
+    rng = random.Random(0xCAFE)
+    for _ in range(120):
+        buf = _rand_commit(rng).encode()
+        if not buf:
+            continue
+        cut = rng.randrange(len(buf))
+        _both(buf[:cut])
+        _both(buf[cut:])
+
+
+def test_garbage_agrees():
+    rng = random.Random(0xBAD)
+    for _ in range(200):
+        _both(rng.randbytes(rng.randrange(0, 96)))
+
+
+def test_appended_and_spliced_agree():
+    """Concatenations and field-order shuffles — shapes a mutation of a
+    single buffer rarely produces."""
+    rng = random.Random(0x7EA)
+    bufs = [_rand_commit(rng).encode() for _ in range(40)]
+    for _ in range(120):
+        a, b = rng.choice(bufs), rng.choice(bufs)
+        i = rng.randrange(len(a) + 1) if a else 0
+        j = rng.randrange(len(b) + 1) if b else 0
+        _both(a[:i] + b[j:])

@@ -1,5 +1,5 @@
 """Native C++ Ed25519 engine: differential vs the pure-Python oracle
-(csrc/ed25519_native.cpp via ctypes; the reference's curve25519-voi
+(cometbft_tpu/csrc/ed25519_native.cpp via ctypes; the reference's curve25519-voi
 assembly analogue for the host-side per-signature path)."""
 
 import numpy as np

@@ -5,11 +5,11 @@
 set -e
 cd "$(dirname "$0")/.."
 g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -pthread \
-    csrc/ed25519_native.cpp csrc/asan_selftest.cpp -o /tmp/ed25519_asan
+    cometbft_tpu/csrc/ed25519_native.cpp cometbft_tpu/csrc/asan_selftest.cpp -o /tmp/ed25519_asan
 /tmp/ed25519_asan
 # second pass with -march=native: on IFMA-capable hosts this compiles
-# and sanitizes the AVX-512 vector engine (csrc/ed25519_ifma.inc) too
+# and sanitizes the AVX-512 vector engine (cometbft_tpu/csrc/ed25519_ifma.inc) too
 g++ -O1 -g -march=native -fsanitize=address,undefined \
     -fno-omit-frame-pointer -pthread \
-    csrc/ed25519_native.cpp csrc/asan_selftest.cpp -o /tmp/ed25519_asan_nat
+    cometbft_tpu/csrc/ed25519_native.cpp cometbft_tpu/csrc/asan_selftest.cpp -o /tmp/ed25519_asan_nat
 /tmp/ed25519_asan_nat
