@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..ops import ed25519_verify
 
@@ -69,7 +69,7 @@ def sharded_verify_fn(mesh: Mesh, axes: str | tuple[str, ...] = "sig"):
         mesh=mesh,
         in_specs=(spec_b,) * 6,
         out_specs=(P(), spec_b),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn)
 
