@@ -26,9 +26,11 @@ class Env:
     def __init__(self, *, block_store=None, state_store=None, consensus=None,
                  mempool=None, switch=None, event_bus=None, tx_indexer=None,
                  block_indexer=None, genesis_doc=None, app_conns=None,
-                 node_info=None, evidence_pool=None, pex_reactor=None):
+                 node_info=None, evidence_pool=None, pex_reactor=None,
+                 consensus_reactor=None):
         self.evidence_pool = evidence_pool
         self.pex_reactor = pex_reactor
+        self.consensus_reactor = consensus_reactor
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -206,6 +208,54 @@ def header(env, params):
     return {"header": _header_json(blk.header)}
 
 
+def header_by_hash(env, params):
+    """Header lookup by block hash (reference rpc/core/blocks.go:108
+    HeaderByHash; an absent block returns an empty result, not an
+    error, matching the reference)."""
+    want = bytes.fromhex(params.get("hash", ""))
+    blk = env.block_store.load_block_by_hash(want)
+    if blk is None:
+        return {"header": None}
+    return {"header": _header_json(blk.header)}
+
+
+def blockchain(env, params):
+    """BlockchainInfo: block metas for [min_height, max_height], newest
+    first, at most 20 (reference rpc/core/blocks.go:27 BlockchainInfo +
+    filterMinMax :59 — zero means "default", min is clamped to the store
+    base so pruned heights degrade gracefully)."""
+    limit = 20
+    bs = env.block_store
+    base, height = bs.base(), bs.height()
+    try:
+        mn = int(params.get("min_height", 0) or 0)
+        mx = int(params.get("max_height", 0) or 0)
+    except (TypeError, ValueError):
+        raise RPCError(-32602, "min_height/max_height must be integers")
+    if mn < 0 or mx < 0:
+        raise RPCError(-32602, "heights must be non-negative")
+    mn = mn or 1
+    mx = min(height, mx or height)
+    mn = max(base, mn, mx - limit + 1)
+    if mn > mx:
+        raise RPCError(
+            -32602, f"min height {mn} can't be greater than max height {mx}"
+        )
+    metas = []
+    for h in range(mx, mn - 1, -1):
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            continue
+        blk, size = meta
+        metas.append({
+            "block_id": {"hash": _hx(blk.hash())},
+            "block_size": str(size),
+            "header": _header_json(blk.header),
+            "num_txs": str(len(blk.data.txs)),
+        })
+    return {"last_height": str(height), "block_metas": metas}
+
+
 def commit(env, params):
     h = _get_height(env, params)
     blk = env.block_store.load_block(h)
@@ -304,6 +354,84 @@ def consensus_state(env, params):
             "locked_round": cs.locked_round,
             "valid_round": cs.valid_round,
         }
+    }
+
+
+def _vote_set_json(vs) -> dict | None:
+    if vs is None:
+        return None
+    ba = vs.bit_array()
+    maj, ok = vs.two_thirds_majority()
+    return {
+        "votes_bit_array": "".join(
+            "x" if ba.get(i) else "_" for i in range(ba.size())
+        ),
+        "count": vs.size(),
+        "two_thirds_majority": _block_id_json(maj) if ok and maj else None,
+    }
+
+
+def dump_consensus_state(env, params):
+    """Full round-state dump plus per-peer consensus states (reference
+    rpc/core/consensus.go:56 DumpConsensusState). The concise summary
+    lives at consensus_state; this one carries the vote bitmaps and the
+    reactor's per-peer (height, round, step) view for operators
+    debugging a stall."""
+    cs = env.consensus
+    votes = []
+    # snapshot under the GIL: the consensus thread inserts rounds into
+    # _sets concurrently (height_vote_set.py _ensure_round) and a live
+    # dict iteration would intermittently raise; dict.copy() is atomic
+    # and prevotes/precommits are .get()-safe for rounds added after
+    hvs = cs.votes
+    for r in sorted(hvs._sets.copy()):
+        votes.append({
+            "round": r,
+            "prevotes": _vote_set_json(hvs.prevotes(r)),
+            "precommits": _vote_set_json(hvs.precommits(r)),
+        })
+    rs = {
+        "height": str(cs.height),
+        "round": cs.round,
+        "step": int(cs.step),
+        "locked_round": cs.locked_round,
+        "locked_block_hash": _hx(
+            cs.locked_block.hash() if getattr(cs, "locked_block", None) else b""
+        ),
+        "valid_round": cs.valid_round,
+        "valid_block_hash": _hx(
+            cs.valid_block.hash() if getattr(cs, "valid_block", None) else b""
+        ),
+        "proposal": cs.proposal is not None,
+        "height_vote_set": votes,
+    }
+    peers = []
+    reactor = env.consensus_reactor
+    if reactor is not None:
+        for ps in list(reactor._peers.values()):
+            peers.append({
+                "node_address": ps.peer.id,
+                "peer_state": {
+                    "height": str(ps.height),
+                    "round": ps.round,
+                    "step": ps.step,
+                    "last_commit_round": ps.last_commit_round,
+                    "proposal_seen": ps.proposal_seen,
+                },
+            })
+    return {"round_state": rs, "peers": peers}
+
+
+def check_tx(env, params):
+    """Run CheckTx against the app without touching the mempool
+    (reference rpc/core/mempool.go:188 CheckTx)."""
+    tx = bytes.fromhex(params["tx"])
+    r = env.app_conns.mempool.check_tx(tx)
+    return {
+        "code": r.code,
+        "data": _hx(r.data),
+        "log": r.log,
+        "gas_wanted": str(r.gas_wanted),
     }
 
 
@@ -577,11 +705,21 @@ unsafe_dial_peers.__doc__ = unsafe_dial_seeds.__doc__ = (
 )
 
 
+def unsafe_flush_mempool(env, params):
+    """Drop every transaction from the mempool (reference
+    rpc/core/dev.go:9 UnsafeFlushMempool)."""
+    if env.mempool is None:
+        raise RPCError(-32603, "mempool unavailable")
+    env.mempool.flush()
+    return {}
+
+
 # unsafe operator routes, served only when rpc.unsafe is enabled
 # (reference rpc/core/routes.go AddUnsafeRoutes gated by config Unsafe)
 UNSAFE_ROUTES = {
     "unsafe_dial_seeds": unsafe_dial_seeds,
     "unsafe_dial_peers": unsafe_dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
 }
 
 ROUTES = {
@@ -593,8 +731,12 @@ ROUTES = {
     "abci_query": abci_query,
     "block": block,
     "block_by_hash": block_by_hash,
+    "blockchain": blockchain,
     "header": header,
+    "header_by_hash": header_by_hash,
     "commit": commit,
+    "check_tx": check_tx,
+    "dump_consensus_state": dump_consensus_state,
     "block_results": block_results,
     "validators": validators,
     "genesis": genesis,

@@ -112,6 +112,15 @@ class BlockStore:
         # so BlockID/part-set work skips the re-encode
         return Block.decode(raw, trusted_bytes=True) if raw else None
 
+    def load_block_meta(self, height: int) -> tuple[Block, int] | None:
+        """(block, wire size) without a re-encode — the stored bytes'
+        length IS the canonical size (reference store.go LoadBlockMeta
+        serves BlockMeta.BlockSize the same way)."""
+        raw = self._db.get(_key_block(height))
+        if not raw:
+            return None
+        return Block.decode(raw, trusted_bytes=True), len(raw)
+
     def load_block_by_hash(self, block_hash: bytes) -> Block | None:
         """O(1) via the hash→height index written at save time
         (reference internal/store/store.go LoadBlockByHash)."""

@@ -141,6 +141,57 @@ def test_rpc_broadcast_and_tx_search(rpc_node):
     assert bytes.fromhex(q["response"]["value"]) == b"42"
 
 
+def test_rpc_info_routes(rpc_node):
+    """blockchain / header_by_hash / check_tx / dump_consensus_state
+    (reference rpc/core/routes.go:23-62)."""
+    from cometbft_tpu.rpc import HTTPClient
+
+    host, port = rpc_node.rpc_addr
+    c = HTTPClient(f"http://{host}:{port}")
+    latest = int(c.status()["sync_info"]["latest_block_height"])
+
+    bc = c.blockchain()
+    assert int(bc["last_height"]) >= latest
+    # the node keeps committing between the two RPCs: compare against
+    # the height THIS response reports, not the earlier status call
+    assert len(bc["block_metas"]) == min(int(bc["last_height"]), 20)
+    hs = [int(m["header"]["height"]) for m in bc["block_metas"]]
+    assert hs == sorted(hs, reverse=True), "newest first"
+    assert int(bc["block_metas"][0]["block_size"]) > 0
+    # explicit window + the reference's min>max error
+    bc2 = c.blockchain(min_height=1, max_height=2)
+    assert [int(m["header"]["height"]) for m in bc2["block_metas"]] == [2, 1]
+    with pytest.raises(RuntimeError):
+        c.blockchain(min_height=5, max_height=2)
+
+    want = bc2["block_metas"][0]["block_id"]["hash"]
+    hdr = c.header_by_hash(hash=want.lower())
+    assert hdr["header"]["height"] == "2"
+    assert c.header_by_hash(hash="ab" * 32)["header"] is None
+
+    ct = c.check_tx(tx=b"ct-key=1".hex())
+    assert ct["code"] == 0
+    # check_tx must NOT enqueue: the mempool is untouched
+    assert c.num_unconfirmed_txs()["n_txs"] == "0"
+
+    dump = c.dump_consensus_state()
+    assert int(dump["round_state"]["height"]) >= latest
+    hvs = dump["round_state"]["height_vote_set"]
+    assert isinstance(hvs, list) and hvs, "rounds present"
+    assert "votes_bit_array" in (hvs[0]["prevotes"] or hvs[0]["precommits"])
+    assert dump["peers"] == []  # single node
+
+
+def test_rpc_unsafe_flush_mempool(rpc_node):
+    from cometbft_tpu.rpc.routes import Env, unsafe_flush_mempool
+
+    rpc_node.mempool.check_tx(b"flush-me=1")
+    assert rpc_node.mempool.size() == 1
+    env = Env(mempool=rpc_node.mempool)
+    assert unsafe_flush_mempool(env, {}) == {}
+    assert rpc_node.mempool.size() == 0
+
+
 def test_rpc_websocket_subscribe(rpc_node):
     import base64
     import socket
