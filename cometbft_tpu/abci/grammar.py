@@ -1,0 +1,195 @@
+"""ABCI conformance grammar: recording + checking legal call sequences.
+
+The reference validates every e2e node's recorded ABCI call sequence
+against a grammar of legal sequences (reference
+test/e2e/pkg/grammar/checker.go, abci_grammar.md):
+
+    Start           : CleanStart | Recovery ;
+    CleanStart      : InitChain ConsensusExec | StateSync ConsensusExec ;
+    StateSync       : StateSyncAttempts SuccessSync | SuccessSync ;
+    StateSyncAttempt: OfferSnapshot ApplyChunks | OfferSnapshot ;
+    SuccessSync     : OfferSnapshot ApplyChunks ;
+    Recovery        : InitChain ConsensusExec | ConsensusExec ;
+    ConsensusHeight : ConsensusRounds FinalizeBlock Commit
+                    | FinalizeBlock Commit ;
+
+This module is the TPU framework's equivalent: `RecordingApp` wraps any
+Application and appends grammar-relevant call names to an append-only
+log (one file per node home, one `== start ==` marker per process
+start, so each execution is checked separately as clean-start vs
+recovery); `check_abci_grammar` is a hand-rolled scanner over one
+execution's calls — it reports *located* violations (call index +
+height) instead of a parser's generic "syntax error", which is what an
+operator debugging a consensus-split actually wants.
+
+`info`, `echo`, `query`, `check_tx` and the snapshot-serving calls
+(`list_snapshots`, `load_snapshot_chunk`) are excluded like the
+reference excludes Info: RPC clients and peers trigger them at
+unpredictable points.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+GRAMMAR_CALLS = frozenset({
+    "init_chain", "offer_snapshot", "apply_snapshot_chunk",
+    "prepare_proposal", "process_proposal", "extend_vote",
+    "verify_vote_extension", "finalize_block", "commit",
+})
+
+START_MARKER = "== start =="
+
+
+class RecordingApp:
+    """Transparent Application wrapper that records grammar calls.
+
+    Calls append to `log_path` (crash-safe: line-buffered append so a
+    kill -9 loses at most the in-flight line) and to the in-memory
+    `calls` list for in-process tests.
+    """
+
+    def __init__(self, app, log_path: str | None = None):
+        self._app = app
+        self._lock = threading.Lock()
+        self.calls: list[str] = []
+        self._fh = None
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            self._fh = open(log_path, "a", buffering=1)
+            self._fh.write(START_MARKER + "\n")
+
+    def _record(self, name: str) -> None:
+        with self._lock:
+            self.calls.append(name)
+            if self._fh is not None:
+                self._fh.write(name + "\n")
+
+    def __getattr__(self, name):
+        fn = getattr(self._app, name)
+        if callable(fn) and name in GRAMMAR_CALLS:
+            def wrapper(*a, __fn=fn, __name=name, **kw):
+                self._record(__name)
+                return __fn(*a, **kw)
+            return wrapper
+        return fn
+
+
+def read_executions(log_path: str) -> list[list[str]]:
+    """Split a node's call log into per-process-start executions."""
+    if not os.path.exists(log_path):
+        return []
+    execs: list[list[str]] = []
+    with open(log_path) as f:
+        for line in f:
+            line = line.strip()
+            if line == START_MARKER:
+                execs.append([])
+            elif line:
+                if not execs:  # tolerate a truncated first marker
+                    execs.append([])
+                execs[-1].append(line)
+    return execs
+
+
+def check_abci_grammar(calls: list[str], first_execution: bool = True) -> list[str]:
+    """Validate one execution's call sequence; returns located errors
+    (empty = conforming). `first_execution` enforces the CleanStart
+    production (the chain's very first process must init_chain or
+    state-sync); later executions may be Recovery (straight into
+    consensus via WAL/handshake replay)."""
+    errors: list[str] = []
+    i, n = 0, len(calls)
+
+    for c in calls:
+        if c not in GRAMMAR_CALLS:
+            return [f"unknown ABCI call {c!r} in log"]
+
+    # ---- prefix: InitChain | StateSync | (Recovery: nothing) ----------
+    if i < n and calls[i] == "init_chain":
+        i += 1
+    elif i < n and calls[i] == "offer_snapshot":
+        last_had_chunk = False
+        any_chunk = False
+        while i < n and calls[i] == "offer_snapshot":
+            i += 1
+            last_had_chunk = False
+            while i < n and calls[i] == "apply_snapshot_chunk":
+                i += 1
+                last_had_chunk = True
+                any_chunk = True
+        # SuccessSync requires >= 1 applied chunk — unless the log was
+        # truncated mid-sync (process killed), which is not a violation
+        if i < n and calls[i] == "init_chain":
+            if any_chunk:
+                errors.append(
+                    "init_chain after snapshot chunks were applied "
+                    f"(call #{i}) — partial restore must not be "
+                    "re-initialized (node/node.py refuses this fallback)"
+                )
+            # else: chunk-less state sync falling back to the deferred
+            # handshake — a framework extension (the reference treats a
+            # failed sync as fatal; this node degrades to a normal
+            # clean start when the app was never touched, node/node.py)
+            i += 1
+        elif not last_had_chunk and i < n:
+            errors.append(
+                "state-sync ended without a successful snapshot "
+                f"application before call #{i} ({calls[i]!r})"
+            )
+    elif first_execution and n:
+        errors.append(
+            f"clean start must begin with init_chain or offer_snapshot, "
+            f"got {calls[0]!r}"
+        )
+
+    # ---- ConsensusExec: (rounds* finalize_block commit)+ --------------
+    height_idx = 0
+    awaiting_commit = False  # saw finalize_block, commit must follow next
+    for j in range(i, n):
+        c = calls[j]
+        if c == "init_chain":
+            errors.append(
+                f"init_chain after consensus started (call #{j}, "
+                f"height idx {height_idx})"
+            )
+        elif c in ("offer_snapshot", "apply_snapshot_chunk"):
+            errors.append(
+                f"{c} after consensus started (call #{j}, "
+                f"height idx {height_idx})"
+            )
+        elif c == "finalize_block":
+            if awaiting_commit:
+                errors.append(
+                    "finalize_block called twice without an intervening "
+                    f"commit (height idx {height_idx}, call #{j})"
+                )
+            awaiting_commit = True
+        elif c == "commit":
+            if not awaiting_commit:
+                errors.append(
+                    f"commit without finalize_block (height idx "
+                    f"{height_idx}, call #{j})"
+                )
+            awaiting_commit = False
+            height_idx += 1
+        else:  # proposal / vote-extension round calls
+            if awaiting_commit:
+                errors.append(
+                    f"{c} between finalize_block and commit (height idx "
+                    f"{height_idx}, call #{j})"
+                )
+    # a trailing awaiting_commit is a legal truncation (process killed
+    # between finalize_block and commit)
+    return errors
+
+
+def check_node_log(log_path: str) -> list[str]:
+    """Check every execution in a node's call log; errors are prefixed
+    with their execution ordinal."""
+    errors = []
+    for e_idx, calls in enumerate(read_executions(log_path)):
+        for err in check_abci_grammar(calls, first_execution=(e_idx == 0)):
+            errors.append(f"execution {e_idx}: {err}")
+    return errors

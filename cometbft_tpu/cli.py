@@ -67,7 +67,10 @@ def cmd_start(args) -> int:
     p = _cfg_paths(args.home)
     cfg = Config.load(p["config_file"])
     cfg.base.home = args.home
-    app = KVStoreApp() if cfg.base.abci == "local" else None
+    app = (
+        KVStoreApp(snapshot_interval=cfg.base.snapshot_interval)
+        if cfg.base.abci == "local" else None
+    )
     node = Node(cfg, app=app)
     node.start()
     print(f"node started: p2p {node.listen_addr}, rpc {getattr(node, 'rpc_addr', None)}")

@@ -32,6 +32,13 @@ class BaseConfig:
     abci: str = "local"  # local | socket
     proxy_app: str = "unix:///tmp/app.sock"
     crypto_backend: str = "tpu"  # tpu | cpu
+    # record grammar-relevant ABCI calls to data/abci_calls.log for the
+    # e2e conformance checker (reference test/e2e/pkg/grammar)
+    abci_call_log: bool = False
+    # in-process kvstore app: take a snapshot every N heights so peers
+    # can state-sync from this node (reference e2e app SnapshotInterval);
+    # 0 disables
+    snapshot_interval: int = 0
 
     def validate(self) -> None:
         if self.db_backend not in ("sqlite", "mem"):

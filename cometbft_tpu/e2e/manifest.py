@@ -16,6 +16,13 @@ from dataclasses import dataclass, field
 class NodeSpec:
     name: str
     power: int = 10
+    # late joiner: start only once the chain reaches this height
+    # (reference manifest.go StartAt); 0 = start with the net
+    start_at: int = 0
+    # join via snapshot restore instead of replaying from genesis
+    # (reference manifest.go StateSync); implies a late start — the
+    # runner anchors trust at a live node's header at join time
+    state_sync: bool = False
 
 
 @dataclass
@@ -32,9 +39,13 @@ class Perturbation:
     """
 
     node: str
-    op: str  # kill | restart | pause | partition | upgrade
+    op: str  # kill | restart | pause | partition | upgrade | split
     at_height: int
     down_s: float = 2.0
+    # op == "split" only: the nodes on `node`'s side of a two-way net
+    # partition (quorum-straddling splits exercise vote-set paths a
+    # single-node isolation cannot); `node` itself is always included
+    group: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -47,6 +58,9 @@ class Manifest:
     timeout_s: float = 180.0
     db_backend: str = "sqlite"
     timeout_commit: float = 0.2
+    # enable ABCI vote extensions from this height via the genesis
+    # consensus params (reference manifest.go VoteExtensionsEnableHeight)
+    vote_extensions_enable_height: int = 0
 
     @classmethod
     def parse(cls, d: dict) -> "Manifest":
@@ -61,34 +75,54 @@ class Manifest:
             timeout_s=float(d.get("timeout_s", 180.0)),
             db_backend=d.get("db_backend", "sqlite"),
             timeout_commit=float(d.get("timeout_commit", 0.2)),
+            vote_extensions_enable_height=int(
+                d.get("vote_extensions_enable_height", 0)
+            ),
         )
 
 
 def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
     """Random testnet manifest (reference test/e2e/generator/generate.go:
-    randomized topology, db backend, timeouts, and a perturbation
-    schedule). Deterministic per seed so failures reproduce."""
+    randomized topology, db backend, timeouts, late-starting /
+    statesync-bootstrapped joiners, and a perturbation schedule).
+    Deterministic per seed so failures reproduce."""
     import random
 
     rng = random.Random(seed)
-    n_nodes = rng.choice([2, 3, 4])
+    n_nodes = rng.choice([2, 3, 4, 5])
     nodes = [
         NodeSpec(name=f"node{i}", power=rng.choice([10, 10, 20]))
         for i in range(n_nodes)
     ]
+    # a late joiner (reference generate.go's startAt nodes): catches up
+    # via block sync, or via state sync when the draw says so — joining
+    # mid-run exercises the catchup paths a genesis start never does.
+    # Only nets with >= 3 genesis validators get one, so the quorum
+    # does not depend on the joiner.
+    if n_nodes >= 3 and rng.random() < 0.5:
+        nodes.append(NodeSpec(
+            name=f"node{n_nodes}",
+            power=10,
+            start_at=rng.choice([3, 4]),
+            state_sync=rng.random() < 0.5,
+        ))
     ops = ["kill", "restart", "pause", "partition", "upgrade"]
     perturbations = []
     # 1-2 perturbations at distinct heights, never two on one node at
     # the same height; partitions only make sense with >= 3 nodes (a
     # 2-node net cannot commit during one and merely stalls) — every
-    # other op, upgrade included, is safe at any size
+    # other op, upgrade included, is safe at any size. Late joiners are
+    # not perturbed: their catchup IS the perturbation (but they may
+    # overlap one on another node — generate.go mixes these freely).
+    genesis_nodes = [n for n in nodes if n.start_at == 0]
     for k in range(rng.choice([1, 2])):
         op = rng.choice(
-            ops if n_nodes >= 3 else [o for o in ops if o != "partition"]
+            ops if len(genesis_nodes) >= 3
+            else [o for o in ops if o != "partition"]
         )
         perturbations.append(
             Perturbation(
-                node=f"node{rng.randrange(n_nodes)}",
+                node=rng.choice(genesis_nodes).name,
                 op=op,
                 at_height=3 + 3 * k,
                 down_s=rng.uniform(1.0, 2.5),

@@ -148,6 +148,18 @@ class Runner:
             raise E2EError("testnet generation failed")
         for i, spec in enumerate(m.nodes):
             home = os.path.join(self.workdir, f"node{i}")
+            if m.vote_extensions_enable_height > 0:
+                # params ride the genesis document to every process node
+                # (reference types/genesis.go GenesisDoc.ConsensusParams)
+                from ..state.types import ABCIParams, ConsensusParams
+                from ..types.genesis import GenesisDoc
+
+                gpath = os.path.join(home, "config", "genesis.json")
+                gd = GenesisDoc.load(gpath)
+                gd.consensus_params = ConsensusParams(abci=ABCIParams(
+                    vote_extensions_enable_height=
+                    m.vote_extensions_enable_height))
+                gd.save(gpath)
             cfg_file = os.path.join(home, "config", "config.toml")
             cfg = Config.load(cfg_file)
             cfg.base.db_backend = m.db_backend
@@ -160,6 +172,11 @@ class Runner:
             cfg.consensus.timeout_precommit_delta = 0.1
             cfg.consensus.timeout_commit = m.timeout_commit
             cfg.p2p.fault_injection = True  # arm the partition channel
+            # record ABCI call sequences for the post-run conformance
+            # check (reference test/e2e/pkg/grammar/checker.go)
+            cfg.base.abci_call_log = True
+            # every node snapshots so statesync joiners find providers
+            cfg.base.snapshot_interval = 2
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
             self.nodes[spec.name] = _ProcNode(spec.name, home, port)
@@ -177,8 +194,10 @@ class Runner:
 
     # ------------------------------------------------------------- drive
     def start(self) -> None:
-        for n in self.nodes.values():
-            n.start()
+        late = {s.name for s in self.manifest.nodes if s.start_at > 0}
+        for name, n in self.nodes.items():
+            if name not in late:
+                n.start()
         if self.manifest.tx_rate > 0:
             self._load_thread = threading.Thread(
                 target=self._load_loop, daemon=True
@@ -280,16 +299,24 @@ class Runner:
         m = self.manifest
         self.start()
         try:
-            pending = sorted(m.perturbations, key=lambda p: p.at_height)
+            # one height-ordered schedule: perturbations + late joins
+            pending = sorted(
+                [(p.at_height, 0, p) for p in m.perturbations]
+                + [(s.start_at, 1, s) for s in m.nodes if s.start_at > 0],
+                key=lambda t: (t[0], t[1]),
+            )
             deadline = time.monotonic() + m.timeout_s
-            for p in pending:
-                while self.max_height() < p.at_height:
+            for at_height, kind, ev in pending:
+                while self.max_height() < at_height:
                     if time.monotonic() > deadline:
                         raise E2EError(
-                            f"timeout before perturbation at {p.at_height}"
+                            f"timeout before event at {at_height}"
                         )
                     time.sleep(0.25)
-                self._apply(p)
+                if kind == 0:
+                    self._apply(ev)
+                else:
+                    self._start_late(ev)
             self.wait_for_height(
                 m.target_height, max(deadline - time.monotonic(), 1.0)
             )
@@ -314,6 +341,15 @@ class Runner:
             self._partition(p.node, True)
             time.sleep(p.down_s)
             self._partition(p.node, False)
+        elif p.op == "split":
+            # two-way net partition: p.group (plus p.node) vs the rest.
+            # With the group sized to straddle the quorum boundary, no
+            # side can commit — progress must resume only on heal
+            # (reference perturb.go's netem-based splits).
+            side_a = set(p.group) | {p.node}
+            self._split(side_a, True)
+            time.sleep(p.down_s)
+            self._split(side_a, False)
         elif p.op == "upgrade":
             # restart as a newer build (reference perturb.go's binary
             # swap): the node comes back advertising a bumped software
@@ -325,24 +361,63 @@ class Runner:
         else:
             raise E2EError(f"unknown perturbation op {p.op!r}")
 
-    def _partition(self, name: str, up: bool) -> None:
-        """Isolate `name` from every other node (or heal): each side's
-        partition.json lists the peer ids it must drop/refuse; the
-        switches poll the file (p2p/switch.py watch_partition_file)."""
-        target_id = self._node_id(name)
-        for other, n in self.nodes.items():
-            blocked: list[str] = []
+    def _start_late(self, spec) -> None:
+        """Start a late-joining node (reference manifest.go StartAt). A
+        state_sync joiner is anchored at runtime: trust hash = a live
+        node's header hash at a recent height, exactly how an operator
+        would bootstrap one out-of-band."""
+        from ..config import Config
+
+        node = self.nodes[spec.name]
+        if spec.state_sync:
+            anchor_h, anchor_hash = self._trust_anchor()
+            cfg_file = os.path.join(node.home, "config", "config.toml")
+            cfg = Config.load(cfg_file)
+            cfg.statesync.enable = True
+            cfg.statesync.trust_height = anchor_h
+            cfg.statesync.trust_hash = anchor_hash
+            cfg.statesync.discovery_time_s = 1.0
+            cfg.save(cfg_file)
+        node.start()
+
+    def _trust_anchor(self) -> tuple[int, str]:
+        """(height, header hash hex) from the first live node that
+        answers; anchored at height 1 (any committed header works — the
+        light client skip-verifies forward from it)."""
+        for n in self.nodes.values():
+            try:
+                r = _rpc(n.rpc_port, "block", {"height": 1})
+                return 1, r["block_id"]["hash"].lower()
+            except Exception:  # noqa: BLE001 — node may be down/perturbed
+                continue
+        raise E2EError("no live node to anchor state sync trust")
+
+    def _split(self, side_a: set, up: bool) -> None:
+        """Two-way partition: every node's partition.json lists the
+        peer ids on the other side to drop/refuse (heal when up=False);
+        the switches poll the file (p2p/switch.py
+        watch_partition_file). Writes are atomic via os.replace so
+        pollers never see a partial file."""
+        ids = {name: self._node_id(name) for name in self.nodes}
+        for name, n in self.nodes.items():
             if up:
-                blocked = (
-                    [self._node_id(o) for o in self.nodes if o != name]
-                    if other == name
-                    else [target_id]
-                )
+                mine = name in side_a
+                blocked = [
+                    ids[o] for o in self.nodes
+                    if o != name and (o in side_a) != mine
+                ]
+            else:
+                blocked = []
             path = os.path.join(n.home, "data", "partition.json")
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(blocked, f)
-            os.replace(tmp, path)  # atomic: pollers never see a partial
+            os.replace(tmp, path)
+
+    def _partition(self, name: str, up: bool) -> None:
+        """Isolate `name` from every other node (or heal): the
+        degenerate split {name} vs the rest."""
+        self._split({name}, up)
 
     def stop_all(self) -> None:
         self._load_stop.set()
@@ -385,7 +460,26 @@ class Runner:
                         raise E2EError(
                             f"hash divergence at height {h}: {a} vs {b}"
                         )
+        grammar = self.check_abci_grammar()
         return {
             "heights": dict(zip(chains, heights)),
             "txs_sent": self.txs_sent,
+            "abci_executions": grammar,
         }
+
+    def check_abci_grammar(self) -> dict:
+        """Validate every node's recorded ABCI call sequence against the
+        legal-sequence grammar (reference test/e2e/pkg/grammar); raises
+        on any violation. Returns per-node execution counts."""
+        from ..abci.grammar import check_node_log, read_executions
+
+        counts = {}
+        for name, n in self.nodes.items():
+            log_path = os.path.join(n.home, "data", "abci_calls.log")
+            errs = check_node_log(log_path)
+            if errs:
+                raise E2EError(
+                    f"ABCI grammar violations on {name}: " + "; ".join(errs)
+                )
+            counts[name] = len(read_executions(log_path))
+        return counts

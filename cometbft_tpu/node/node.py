@@ -62,6 +62,13 @@ class Node:
         )
 
         # --- app conns -------------------------------------------------
+        if config.base.abci_call_log and config.base.abci == "local" and app is not None:
+            # conformance recording (reference test/e2e/pkg/grammar):
+            # every grammar-relevant ABCI call appends to data/ so the
+            # e2e runner can validate the sequence post-run
+            from ..abci.grammar import RecordingApp
+
+            app = RecordingApp(app, _p("data/abci_calls.log"))
         if config.base.abci == "grpc":
             from ..abci.grpc_transport import GrpcAppConns
 
@@ -104,12 +111,27 @@ class Node:
             app_hash=self.genesis_doc.app_hash,
             initial_height=self.genesis_doc.initial_height,
             genesis_time=self.genesis_doc.genesis_time,
+            consensus_params=self.genesis_doc.consensus_params,
         )
         self.handshaker = Handshaker(
             self.state_store, self.block_store, genesis_state,
             backend=config.base.crypto_backend,
         )
-        sm_state = self.handshaker.handshake(self.app_conns)
+        # A fresh node about to state-sync must NOT handshake first: the
+        # reference skips doHandshake entirely when state sync will run
+        # (node/node.go:575-584), so the app sees OfferSnapshot without a
+        # prior InitChain — the CleanStart:StateSync production of the
+        # ABCI grammar. If state sync later fails or finds no snapshots,
+        # start() runs the deferred handshake before block sync.
+        self._handshake_deferred = bool(
+            getattr(config, "statesync", None)
+            and config.statesync.enable
+            and self.state_store.load() is None
+        )
+        if self._handshake_deferred:
+            sm_state = genesis_state.copy()
+        else:
+            sm_state = self.handshaker.handshake(self.app_conns)
 
         # --- mempool / evidence / executor ----------------------------
         self.mempool = CListMempool(
@@ -241,6 +263,7 @@ class Node:
             app_conns=self.app_conns,
             node_info=info,
             evidence_pool=self.evidence_pool,
+            consensus_reactor=self.consensus_reactor,
         )
         self.rpc_server = None
         self.grpc_server = None
@@ -318,6 +341,13 @@ class Node:
         # state sync (if enabled and fresh) -> block sync -> consensus
         if self.statesync_pool is not None:
             self._run_state_sync()
+        if self._handshake_deferred and self.state_store.load() is None:
+            # state sync did not complete (no snapshots / failed): run
+            # the handshake that was skipped in anticipation of it, so
+            # the app still gets its InitChain before block sync
+            sm_state = self.handshaker.handshake(self.app_conns)
+            self.blocksync_reactor.state = sm_state
+            self.consensus.reset_to_state(sm_state)
         # catch up over block sync before consensus when we have peers
         # that are ahead (reference SwitchToConsensus hand-off); sync()
         # itself drives the status exchange and gives up after 3 s when
@@ -370,6 +400,25 @@ class Node:
             trusting_period_s=cfg.trust_period_s,
             backend=self.config.base.crypto_backend,
         )
+        # Count chunk applications: a failure AFTER the app ingested any
+        # chunk leaves the app in an undefined partial state, and the
+        # deferred-handshake fallback (start()) would init_chain on top
+        # of it. The reference treats a failed sync as fatal for exactly
+        # this reason (node/node.go startStateSync error path); we only
+        # permit the fallback when the app was never touched.
+        class _CountingSnapshotConn:
+            def __init__(self, conn):
+                self._conn = conn
+                self.chunks_applied = 0
+
+            def apply_snapshot_chunk(self, *a, **kw):
+                self.chunks_applied += 1
+                return self._conn.apply_snapshot_chunk(*a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(self._conn, name)
+
+        snap_conn = _CountingSnapshotConn(self.app_conns.snapshot)
         try:
             lc.initialize(cfg.trust_height, bytes.fromhex(cfg.trust_hash))
             provider = LightStateProvider(
@@ -378,7 +427,7 @@ class Node:
                 initial_height=self.genesis_doc.initial_height,
             )
             syncer = Syncer(
-                self.app_conns.snapshot,
+                snap_conn,
                 provider,
                 self.statesync_reactor.fetch_chunk,
                 pool=self.statesync_pool,
@@ -387,10 +436,18 @@ class Node:
             )
             state, commit = syncer.sync_any()
         except StateSyncError as e:
+            if snap_conn.chunks_applied:
+                raise RuntimeError(
+                    "state sync failed after applying snapshot chunks; "
+                    "app state is undefined — refusing to fall back "
+                    f"(reference startStateSync is fatal here): {e}"
+                ) from e
             log.warn("state sync failed; falling back to block sync",
                      err=str(e)[:120])
             return
         except Exception as e:  # noqa: BLE001 — e.g. bad trust anchor
+            if snap_conn.chunks_applied:
+                raise
             log.warn("state sync aborted", err=str(e)[:120])
             return
         self.state_store.save(state)

@@ -394,8 +394,11 @@ def make_genesis_state(
     app_hash: bytes = b"",
     initial_height: int = 1,
     genesis_time: Timestamp | None = None,
+    consensus_params=None,
 ) -> State:
     """Genesis -> State (reference internal/state/state.go MakeGenesisState)."""
+    from .types import ConsensusParams
+
     return State(
         chain_id=chain_id,
         initial_height=initial_height,
@@ -405,4 +408,5 @@ def make_genesis_state(
         last_validators=None,  # empty at genesis (reference MakeGenesisState)
         next_validators=validators.copy_increment_proposer_priority(1),
         last_height_validators_changed=initial_height,
+        consensus_params=consensus_params or ConsensusParams(),
     )

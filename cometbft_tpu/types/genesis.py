@@ -41,6 +41,11 @@ class GenesisDoc:
     validators: list[GenesisValidator] = field(default_factory=list)
     app_hash: bytes = b""
     app_state: bytes = b""
+    # ConsensusParams (state.types) or None for the defaults — carried
+    # in genesis.json like the reference (types/genesis.go
+    # GenesisDoc.ConsensusParams), so e.g. vote-extension enablement
+    # reaches process nodes through the boot document
+    consensus_params: object | None = None
 
     def validate_basic(self) -> None:
         """reference types/genesis.go ValidateAndComplete."""
@@ -80,28 +85,44 @@ class GenesisDoc:
 
     # ------------------------------------------------------------------
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "chain_id": self.chain_id,
-                "genesis_time": {
-                    "seconds": self.genesis_time.seconds,
-                    "nanos": self.genesis_time.nanos,
-                },
-                "initial_height": self.initial_height,
-                "validators": [
-                    {
-                        "pub_key": gv.pub_key_bytes.hex(),
-                        "pub_key_type": gv.pub_key_type,
-                        "power": gv.power,
-                        "name": gv.name,
-                    }
-                    for gv in self.validators
-                ],
-                "app_hash": self.app_hash.hex(),
-                "app_state": self.app_state.hex(),
+        d = {
+            "chain_id": self.chain_id,
+            "genesis_time": {
+                "seconds": self.genesis_time.seconds,
+                "nanos": self.genesis_time.nanos,
             },
-            indent=2,
-        )
+            "initial_height": self.initial_height,
+            "validators": [
+                {
+                    "pub_key": gv.pub_key_bytes.hex(),
+                    "pub_key_type": gv.pub_key_type,
+                    "power": gv.power,
+                    "name": gv.name,
+                }
+                for gv in self.validators
+            ],
+            "app_hash": self.app_hash.hex(),
+            "app_state": self.app_state.hex(),
+        }
+        cp = self.consensus_params
+        if cp is not None:
+            d["consensus_params"] = {
+                "block": {"max_bytes": cp.block.max_bytes,
+                          "max_gas": cp.block.max_gas},
+                "evidence": {
+                    "max_age_num_blocks": cp.evidence.max_age_num_blocks,
+                    "max_age_duration_ns": cp.evidence.max_age_duration_ns,
+                    "max_bytes": cp.evidence.max_bytes,
+                },
+                "validator": {
+                    "pub_key_types": list(cp.validator.pub_key_types),
+                },
+                "abci": {
+                    "vote_extensions_enable_height":
+                        cp.abci.vote_extensions_enable_height,
+                },
+            }
+        return json.dumps(d, indent=2)
 
     @classmethod
     def from_json(cls, raw: str) -> "GenesisDoc":
@@ -125,6 +146,24 @@ class GenesisDoc:
             app_hash=bytes.fromhex(d.get("app_hash", "")),
             app_state=bytes.fromhex(d.get("app_state", "")),
         )
+        if "consensus_params" in d:
+            # lazy import: state.types depends on this package
+            from ..state.types import (
+                ABCIParams, BlockParams, ConsensusParams, EvidenceParams,
+                ValidatorParams,
+            )
+
+            p = d["consensus_params"]
+            bp, ep = p.get("block", {}), p.get("evidence", {})
+            vp, ap = p.get("validator", {}), p.get("abci", {})
+            gd.consensus_params = ConsensusParams(
+                block=BlockParams(**bp) if bp else BlockParams(),
+                evidence=EvidenceParams(**ep) if ep else EvidenceParams(),
+                validator=ValidatorParams(
+                    pub_key_types=tuple(vp["pub_key_types"])
+                ) if vp else ValidatorParams(),
+                abci=ABCIParams(**ap) if ap else ABCIParams(),
+            )
         gd.validate_basic()
         return gd
 

@@ -1,0 +1,151 @@
+"""ABCI conformance grammar checker (reference test/e2e/pkg/grammar/
+checker.go + abci_grammar.md): legal sequences pass, violations are
+caught and located, the recorder persists executions across restarts."""
+
+import pytest
+
+from cometbft_tpu.abci.grammar import (
+    START_MARKER,
+    RecordingApp,
+    check_abci_grammar,
+    check_node_log,
+    read_executions,
+)
+
+F, C, I = "finalize_block", "commit", "init_chain"
+O, A = "offer_snapshot", "apply_snapshot_chunk"
+P, R = "prepare_proposal", "process_proposal"
+E, V = "extend_vote", "verify_vote_extension"
+
+
+# ------------------------------------------------------------ legal ----
+def test_clean_start_simple():
+    assert check_abci_grammar([I, F, C, F, C, F, C]) == []
+
+
+def test_clean_start_with_rounds():
+    calls = [I, P, R, F, C, R, F, C, P, F, C, P, R, P, R, F, C]
+    assert check_abci_grammar(calls) == []
+
+
+def test_vote_extension_rounds():
+    calls = [I, P, R, V, E, V, F, C, R, E, F, C]
+    assert check_abci_grammar(calls) == []
+
+
+def test_statesync_start():
+    assert check_abci_grammar([O, A, A, F, C]) == []
+    # failed attempts before the successful one
+    assert check_abci_grammar([O, O, A, A, A, F, C]) == []
+
+
+def test_recovery_without_init_chain():
+    assert check_abci_grammar([F, C, F, C], first_execution=False) == []
+    assert check_abci_grammar([P, F, C], first_execution=False) == []
+
+
+def test_truncations_are_legal():
+    # killed between finalize_block and commit
+    assert check_abci_grammar([I, F, C, F]) == []
+    # killed mid-statesync
+    assert check_abci_grammar([O, A]) == []
+    assert check_abci_grammar([O]) == []
+    # empty execution (process killed before any call)
+    assert check_abci_grammar([]) == []
+
+
+# --------------------------------------------------------- violations --
+def test_double_finalize_block_caught():
+    errs = check_abci_grammar([I, F, F, C])
+    assert len(errs) == 1 and "finalize_block called twice" in errs[0]
+    assert "height idx 0" in errs[0]
+
+
+def test_double_finalize_after_restart_caught():
+    # the reference's headline case: FinalizeBlock twice per height
+    # across restarts — each execution checks independently, so a
+    # recovery execution replaying F twice without commit is caught
+    errs = check_abci_grammar([F, F, C], first_execution=False)
+    assert len(errs) == 1 and "finalize_block called twice" in errs[0]
+
+
+def test_commit_without_finalize_caught():
+    errs = check_abci_grammar([I, C])
+    assert len(errs) == 1 and "commit without finalize_block" in errs[0]
+
+
+def test_init_chain_mid_stream_caught():
+    errs = check_abci_grammar([I, F, C, I, F, C])
+    assert len(errs) == 1 and "init_chain after consensus" in errs[0]
+
+
+def test_snapshot_calls_mid_stream_caught():
+    errs = check_abci_grammar([I, F, C, O, A])
+    assert len(errs) == 2  # both offer and apply flagged
+
+
+def test_proposal_between_finalize_and_commit_caught():
+    errs = check_abci_grammar([I, F, P, C])
+    assert len(errs) == 1 and "between finalize_block and commit" in errs[0]
+
+
+def test_clean_start_must_initialize():
+    errs = check_abci_grammar([F, C], first_execution=True)
+    assert len(errs) == 1 and "clean start" in errs[0]
+
+
+def test_statesync_without_success_caught():
+    # consensus began but no snapshot ever applied a chunk
+    errs = check_abci_grammar([O, F, C])
+    assert len(errs) == 1 and "state-sync" in errs[0]
+
+
+def test_unknown_call_rejected():
+    assert check_abci_grammar([I, "bogus", F, C])
+
+
+# ---------------------------------------------------------- recorder ---
+class _App:
+    def init_chain(self, req):
+        return "ic"
+
+    def finalize_block(self, req):
+        return "fb"
+
+    def commit(self):
+        return 0
+
+    def query(self, path, data, height=0):
+        return "q"
+
+
+def test_recording_app_records_and_delegates(tmp_path):
+    log = str(tmp_path / "data" / "abci_calls.log")
+    app = RecordingApp(_App(), log)
+    assert app.init_chain(None) == "ic"
+    assert app.finalize_block(None) == "fb"
+    assert app.commit() == 0
+    assert app.query("/p", b"") == "q"  # not grammar-relevant
+    assert app.calls == [I, F, C]
+    # restart: second execution appends a new marker
+    app2 = RecordingApp(_App(), log)
+    app2.finalize_block(None)
+    app2.commit(), app2.finalize_block(None), app2.commit()
+    execs = read_executions(log)
+    assert execs == [[I, F, C], [F, C, F, C]]
+    assert check_node_log(log) == []
+
+
+def test_check_node_log_locates_execution(tmp_path):
+    log = str(tmp_path / "abci_calls.log")
+    with open(log, "w") as f:
+        f.write("\n".join([START_MARKER, I, F, C,
+                           START_MARKER, F, F, C]) + "\n")
+    errs = check_node_log(log)
+    assert len(errs) == 1
+    assert errs[0].startswith("execution 1:")
+    assert "finalize_block called twice" in errs[0]
+
+
+def test_check_node_log_missing_file(tmp_path):
+    assert check_node_log(str(tmp_path / "nope.log")) == []
