@@ -44,15 +44,19 @@ BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
 # instead of the per-lane ladder kernel (one multi-scalar multiplication
 # instead of N ladders, reference crypto/ed25519/ed25519.go:207-240).
 # MEASURED head-to-head on the real chip (round 4, 10k batches, depth-8
-# pipeline): ladder 178k sigs/s, RLC 41.7k — despite ~7x fewer field
-# muls, Pippenger's per-round (2B+1)-entry niels gathers are
-# memory-bound on TPU while the ladder's 16-entry per-lane tables stay
-# regular, so the ladder wins by ~4x end-to-end (PROFILE.md). The
-# dispatch keeps the modeled-time comparison with the measured
-# constants: RLC only wins if a future kernel removes the gather wall.
+# pipeline): ladder 178k sigs/s, RLC 41.7k. Round 5's xprof
+# decomposition (PROFILE.md round-5) corrected the round-4 diagnosis:
+# RLC *device* time is 2.11 us/sig — 2x BETTER than the ladder — and
+# the loss is entirely the HOST prepare stage (signed digits + bucket
+# layout, ~20 us/sig of numpy on this 1-core box). The dispatch model
+# therefore carries host, device, and wire terms per path; RLC wins
+# only where the host packer is not the binding stage (multi-core
+# hosts or a future native packer).
 RLC_MIN = 4096
 _DEV_LADDER_US = 4.5   # measured e2e device time per signature (r4)
-_DEV_RLC_US = 24.0     # measured e2e (gather-bound accumulate kernel)
+_DEV_RLC_US = 2.11     # measured xprof device total (r5, PROFILE.md)
+_HOST_RLC_US = 20.0    # rlc.prepare per sig, 1 numpy core (r5 measured)
+_HOST_LADDER_US = 1.6  # ladder submit packing per sig (r4: ~15-22 ms/10k)
 _WIRE_LADDER_B = 96    # R||S||k per lane (73 on the delta fast path)
 # R (32) + A (32, re-shipped each submit: the RLC path keys its random
 # layout per batch, so there is no device-resident A cache analogue) +
@@ -83,9 +87,13 @@ def _link_mbps() -> float:
 
 
 def _rlc_beats_ladder(n: int, b: int) -> bool:
+    # pipelined throughput is bound by the slowest of the three
+    # sequential-resource stages: host packing, wire, device
     bw = _link_mbps() * 1e6  # bytes/sec
-    t_ladder = max(_WIRE_LADDER_B * b / bw, n * _DEV_LADDER_US * 1e-6)
-    t_rlc = max(_WIRE_RLC_B * b / bw, n * _DEV_RLC_US * 1e-6)
+    t_ladder = max(_WIRE_LADDER_B * b / bw, n * _DEV_LADDER_US * 1e-6,
+                   n * _HOST_LADDER_US * 1e-6)
+    t_rlc = max(_WIRE_RLC_B * b / bw, n * _DEV_RLC_US * 1e-6,
+                n * _HOST_RLC_US * 1e-6)
     return t_rlc < t_ladder
 
 
