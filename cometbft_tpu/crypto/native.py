@@ -119,6 +119,14 @@ def _bind(lib) -> None:
         ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
     ]
+    lib.ed25519_pack_rsk.restype = None
+    # void_p operands: callers pass numpy views over their accumulation
+    # buffers zero-copy (bytes() snapshots of MB-scale blobs cost ~0.5 ms
+    # on the submit hot path)
+    lib.ed25519_pack_rsk.argtypes = [
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
+    ]
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -181,25 +189,49 @@ def batch_verify(items) -> bool:
     return bool(lib.ed25519_batch_verify(n, pubs, msgs, lens, sigs))
 
 
-def batch_challenge_scalars(
-    items, sig_blob: bytes | None = None, pub_blob: bytes | None = None
-) -> bytes | None:
+def batch_challenge_scalars(items) -> bytes | None:
     """k_i = SHA-512(R_i || A_i || M_i) mod L for every (pub, msg, sig)
     triple, concatenated 32-byte little-endian scalars; None when the
-    native lib is absent (caller hashes via hashlib). Callers that
-    already hold the concatenated signature/pubkey blobs (the device
-    packers do) pass them to skip re-joining."""
+    native lib is absent (caller hashes via hashlib). The hot submit
+    path uses pack_rsk instead (same engine, strided straight into the
+    wire buffer); this entry serves ad-hoc callers and the differential
+    tests."""
     lib = get_lib()
     if lib is None:
         return None
     n = len(items)
-    sigs = sig_blob if sig_blob is not None else b"".join(it[2] for it in items)
-    pubs = pub_blob if pub_blob is not None else b"".join(it[0] for it in items)
+    sigs = b"".join(it[2] for it in items)
+    pubs = b"".join(it[0] for it in items)
     msgs = b"".join(it[1] for it in items)
     lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
     out = ctypes.create_string_buffer(n * 32)
     lib.ed25519_batch_k(n, sigs, pubs, msgs, lens, out)
     return out.raw
+
+
+def pack_rsk(n: int, sig_blob, pub_blob, msg_blob,
+             msg_lens, out_rsk) -> bool:
+    """Assemble the R||S||k device wire rows (stride 96) for n lanes
+    straight into `out_rsk` (a C-contiguous uint8 numpy array with at
+    least n*96 leading bytes): signature copy + 8-wide challenge
+    hashing + mod-L in one native call. False when the lib is absent
+    (caller packs in Python). The blobs may be bytes, bytearray, or
+    uint8 numpy arrays — all passed zero-copy; `msg_lens` is a uint64
+    numpy array."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "ed25519_pack_rsk"):
+        return False
+    import numpy as _np
+
+    def _addr(buf):
+        return _np.frombuffer(buf, _np.uint8).ctypes.data_as(ctypes.c_void_p)
+
+    lib.ed25519_pack_rsk(
+        n, _addr(sig_blob), _addr(pub_blob), _addr(msg_blob),
+        msg_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        out_rsk.ctypes.data_as(ctypes.c_void_p),
+    )
+    return True
 
 
 def commit_parse(buf: bytes):

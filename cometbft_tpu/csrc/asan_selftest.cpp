@@ -27,6 +27,8 @@ void ed25519_sign(const u8 *seed, const u8 *pub, const u8 *msg, u64 msg_len,
 void ed25519_pubkey(const u8 *seed, u8 *pub_out);
 void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
                      const u64 *msg_lens, u8 *out);
+void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
+                      const u64 *msg_lens, u8 *out_rsk);
 void merkle_root_native(u64 n, const u8 *blob, const u64 *offs, u8 *out32);
 void sha256_oneshot(const u8 *data, u64 len, u8 *out32);
 long commit_parse(const u8 *buf, u64 len, u64 cap, u64 *head, u8 *flags,
@@ -95,6 +97,18 @@ static int new_surface_checks() {
         std::vector<u8> out(N * 32);
         ed25519_batch_k(N, sigs.data(), pubs.data(), msgs.data(),
                         lens.data(), out.data());
+        // pack_rsk writes stride-96 rows into the same shapes; its k
+        // bytes must agree with batch_k's on every lane
+        std::vector<u8> rsk(N * 96);
+        ed25519_pack_rsk(N, sigs.data(), pubs.data(), msgs.data(),
+                         lens.data(), rsk.data());
+        for (int i = 0; i < N; i++) {
+            if (memcmp(rsk.data() + i * 96, sigs.data() + i * 64, 64) ||
+                memcmp(rsk.data() + i * 96 + 64, out.data() + i * 32, 32)) {
+                printf("pack_rsk mismatch at %d\n", i);
+                return 1;
+            }
+        }
     }
     // --- commit_parse: synthesized valid-ish wire, then mutation fuzz
     {

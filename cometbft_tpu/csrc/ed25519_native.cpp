@@ -1148,15 +1148,17 @@ void sha512_digest(const u8 *msg, u64 len, u8 *out) {
 }
 
 
-// Batch challenge scalars for the prehashed TPU wire path: k_i =
-// SHA-512(R_i || A_i || M_i) mod L, one C call for the whole batch.
-// Runs eight equal-length preimages at a time through the AVX-512
-// multi-buffer SHA-512 (csrc/sha512_mb.inc) — the scalar hash loop was
-// ~12 ms of every 10k-lane submit on the single-core host; commit sign
-// bytes within a batch are uniformly sized, so grouping by length
-// almost always fills full groups.
-void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
-                     const u64 *msg_lens, u8 *out) {
+// Batch challenge scalars: k_i = SHA-512(R_i || A_i || M_i) mod L,
+// written at out + i*out_stride. Eight equal-length preimages at a time
+// ride the AVX-512 multi-buffer SHA-512 (csrc/sha512_mb.inc) — the
+// scalar hash loop was ~12 ms of every 10k-lane submit on the
+// single-core host; commit sign bytes within a batch are uniformly
+// sized, so grouping by length almost always fills full groups. The
+// strided output serves both the k-blob export (stride 32) and the
+// in-place R||S||k wire assembly (stride 96).
+static void batch_k_strided(u64 n, const u8 *sigs, const u8 *pubs,
+                            const u8 *msgs, const u64 *msg_lens, u8 *out,
+                            u64 out_stride) {
     u64 off = 0;
     u64 i = 0;
     bool mb = sha512mb::usable();
@@ -1176,7 +1178,9 @@ void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
             u64 o = off;
             for (int k = 0; k < 8; k++) {
                 u8 *buf = scratch[k];
-                memset(buf, 0, nblocks * 128);
+                // zero only the padding tail: bytes [0, total) are
+                // overwritten by the copies below
+                memset(buf + total, 0, nblocks * 128 - total);
                 memcpy(buf, sigs + (i + k) * 64, 32);
                 memcpy(buf + 32, pubs + (i + k) * 32, 32);
                 memcpy(buf + 64, msgs + o, ml);
@@ -1191,7 +1195,7 @@ void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
             for (int k = 0; k < 8; k++) {
                 u64 kk[4];
                 sc::reduce512(kk, digests[k]);
-                sc::to_bytes(out + (i + k) * 32, kk);
+                sc::to_bytes(out + (i + k) * out_stride, kk);
             }
             i += 8;
             off = o;
@@ -1201,11 +1205,26 @@ void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
                          ml, digest);
             u64 kk[4];
             sc::reduce512(kk, digest);
-            sc::to_bytes(out + i * 32, kk);
+            sc::to_bytes(out + i * out_stride, kk);
             off += ml;
             i += 1;
         }
     }
+}
+
+void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
+                     const u64 *msg_lens, u8 *out) {
+    batch_k_strided(n, sigs, pubs, msgs, msg_lens, out, 32);
+}
+
+// Assemble the device wire buffer R||S||k for n lanes directly into the
+// caller's (stride 96) numpy array: one call replaces the Python-side
+// k-blob round trip plus two numpy copies on the hot submit path
+// (crypto/ed25519.py _launch_device).
+void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
+                      const u64 *msg_lens, u8 *out_rsk) {
+    for (u64 i = 0; i < n; i++) memcpy(out_rsk + i * 96, sigs + i * 64, 64);
+    batch_k_strided(n, sigs, pubs, msgs, msg_lens, out_rsk + 64, 96);
 }
 
 }  // extern "C"
