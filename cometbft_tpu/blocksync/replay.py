@@ -143,6 +143,64 @@ class ReplayEngine:
         lane = 0
         singles = 0
 
+        def queue_commit_columnar(commit, vals, height, all_sigs):
+            """Whole-commit queueing without per-CommitSig Python: the
+            native decode columns + the frozen set's ed25519 columns
+            feed one vectorized address check, one native sign-bytes
+            build, and one add_batch. Returns False (caller takes the
+            per-slot path) when any precondition is off — non-ed25519
+            keys, hand-built commit, odd flags/lengths — so behavior is
+            byte-identical where it matters and merely slower where it
+            is rare."""
+            nonlocal lane
+            cols = commit.verify_columns()
+            vcols = vals.ed25519_columns()
+            if cols is None or vcols is None:
+                return False
+            flags, addrs, addr_lens, sig_lens, sigs, _, _ = cols
+            addr_rows, pub_rows, powers = vcols
+            absent = flags == 1
+            # light (tip) semantics verify only COMMIT votes
+            # (reference VerifyCommitLight); full semantics verify
+            # every non-absent signature
+            live = ~absent if all_sigs else flags == 2
+            # structural gates: only ABSENT/COMMIT/NIL flags, 20-byte
+            # addresses and 64-byte signatures on verified lanes
+            if not (
+                (absent | (flags == 2) | (flags == 3)).all()
+                and (addr_lens[live] == 20).all()
+                and (sig_lens[live] == 64).all()
+                and (addr_lens[absent] == 0).all()
+            ):
+                return False
+            if not (addrs[live] == addr_rows[live]).all():
+                return False  # per-slot path localizes the mismatch
+            sb = commit.vote_sign_bytes_blob(chain_id)
+            if sb is None:
+                return False
+            msg_blob, lens = sb
+            if live.all():
+                bv.add_batch(pub_rows, sigs, msg_blob, lens)
+            else:
+                import numpy as _np
+
+                idx = _np.nonzero(live)[0]
+                offs = _np.zeros(len(lens) + 1, _np.int64)
+                _np.cumsum(lens, out=offs[1:])
+                parts = [
+                    msg_blob[offs[i]:offs[i + 1]] for i in idx
+                ]
+                bv.add_batch(
+                    pub_rows[idx], sigs[idx], b"".join(parts), lens[idx]
+                )
+            lane += int(live.sum())
+            commit_power = int(powers[flags == 2].sum())
+            per_commit.append(
+                (height, vals.total_voting_power() * 2 // 3,
+                 (commit_power,))
+            )
+            return True
+
         def queue_commit(commit, vals, expect_bid, height, all_sigs):
             nonlocal lane, singles
             _check_commit_basics(vals, commit, height, expect_bid)
@@ -150,6 +208,8 @@ class ReplayEngine:
                 raise ErrInvalidCommitSize(
                     f"commit size {commit.size()} != validator set {len(vals)}"
                 )
+            if queue_commit_columnar(commit, vals, height, all_sigs):
+                return
             entries = []
             msgs = commit.vote_sign_bytes_all(chain_id)
             for idx, cs in enumerate(commit.signatures):

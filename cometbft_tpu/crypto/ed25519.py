@@ -66,6 +66,12 @@ _WIRE_RLC_B = 116
 
 _LINK_MBPS: float | None = None
 
+# big-endian bytes of the group order, for the vectorized S < L precheck
+_L_BE = np.frombuffer(
+    (2**252 + 27742317777372353535851937790883648493).to_bytes(32, "big"),
+    np.uint8,
+)
+
 
 def _link_mbps() -> float:
     """One-time host->device bandwidth probe (2 MiB device_put). Drives
@@ -229,10 +235,62 @@ class Ed25519BatchVerifier(BatchVerifier):
         self._sig_buf = bytearray()
         self._msg_buf = bytearray()
         self._msg_lens: list[int] = []
+        # add_batch appends whole-commit columns here instead of 1000
+        # (pub, msg, sig) tuples; _materialize() expands them into
+        # _items only on the paths that need per-item access (blame,
+        # RLC prepare, cpu oracle) — the happy path never does
+        self._lazy: list[tuple] = []
+
+    def _materialize(self) -> None:
+        if not self._lazy:
+            return
+        for pub_rows, sig_rows, msg_blob, lens in self._lazy:
+            off = 0
+            for i in range(len(lens)):
+                ln = int(lens[i])
+                self._items.append((
+                    pub_rows[i].tobytes(),
+                    bytes(msg_blob[off:off + ln]),
+                    sig_rows[i].tobytes(),
+                ))
+                off += ln
+        self._lazy.clear()
+
+    def add_batch(self, pub_rows, sig_rows, msg_blob, msg_lens) -> None:
+        """Vectorized add() for a whole commit's worth of ed25519 lanes.
+
+        pub_rows (n,32) u8, sig_rows (n,64) u8, msg_blob bytes,
+        msg_lens uint32/int array; the caller guarantees every row is a
+        structurally-complete 64-byte signature (the replay fast path
+        gates on sig_lens == 64 and falls back otherwise). The S < L
+        precheck runs vectorized; failing lanes get a zeroed signature
+        and precheck_fail=True, matching add() semantics exactly."""
+        n = len(msg_lens)
+        if n == 0:
+            return
+        # S >= L precheck, lexicographic on the big-endian view
+        s_be = sig_rows[:, 63:31:-1]  # (n, 32) most-significant first
+        neq = s_be != _L_BE[None, :]
+        first = neq.argmax(axis=1)
+        rows = np.arange(n)
+        s_byte = s_be[rows, first]
+        l_byte = _L_BE[first]
+        bad = ~(neq.any(axis=1) & (s_byte < l_byte))  # S >= L
+        if bad.any():
+            sig_rows = sig_rows.copy()
+            sig_rows[bad] = 0
+        self._precheck_fail.extend(bad.tolist())
+        self._pub_buf += pub_rows.tobytes()
+        self._sig_buf += sig_rows.tobytes()
+        self._msg_buf += msg_blob
+        self._msg_lens.extend(int(x) for x in msg_lens)
+        self._lazy.append((pub_rows, sig_rows, msg_blob, msg_lens))
+        self._delta = None
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
         if not isinstance(pub_key, Ed25519PubKey):
             return False
+        self._materialize()
         ok = len(sig) == SIG_SIZE
         if ok:
             s = int.from_bytes(sig[32:], "little")
@@ -249,12 +307,13 @@ class Ed25519BatchVerifier(BatchVerifier):
         return ok
 
     def count(self) -> int:
-        return len(self._items)
+        return len(self._precheck_fail)
 
     def verify(self) -> tuple[bool, list[bool]]:
-        if not self._items:
+        if not self.count():
             return False, []
         if self.backend == "cpu":
+            self._materialize()
             bits = [
                 (not bad) and ref.verify(p, m, s)
                 for (p, m, s), bad in zip(self._items, self._precheck_fail)
@@ -273,7 +332,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         (reference: abci/client/socket_client.go:129 pipelined queue);
         ours overlaps host packing with device compute instead.
         """
-        n = len(self._items)
+        n = self.count()
         if not self._force_perlane:
             if n < NATIVE_MAX:
                 pending = self._native_batch()
@@ -302,6 +361,7 @@ class Ed25519BatchVerifier(BatchVerifier):
 
         if not native.available():
             return None
+        self._materialize()
         live = [
             it for it, bad in zip(self._items, self._precheck_fail) if not bad
         ]
@@ -327,6 +387,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         from ..ops.msm import rlc_verify_stream_jit
         from . import rlc as _rlc
 
+        self._materialize()
         n = len(self._items)
         b = _bucket(n)
         skip = np.asarray(self._precheck_fail, bool)
@@ -397,9 +458,10 @@ class Ed25519BatchVerifier(BatchVerifier):
         )
 
         if self._device_sha:
+            self._materialize()
             return self._launch_device_sha()
 
-        n = len(self._items)
+        n = self.count()
         b = _bucket(n)
         # structured-message fast path: when the batch's messages share a
         # common prefix + suffix (replay/commit sign bytes differ only in
@@ -412,8 +474,10 @@ class Ed25519BatchVerifier(BatchVerifier):
             and _delta_beats_prehashed(n, b)
         ):
             if self._delta is None:
+                self._materialize()
                 self._delta = _detect_delta(self._items) or False
             if self._delta:
+                self._materialize()
                 return self._launch_device_delta(self._delta)
         pub_blob = self._pub_buf  # zero-copy; hashed + copied below only
         rsk = np.zeros((b, 96), np.uint8)
@@ -427,6 +491,7 @@ class Ed25519BatchVerifier(BatchVerifier):
             np.asarray(self._msg_lens, np.uint64), rsk,
         )
         if not packed:
+            self._materialize()
             sig_blob = bytes(self._sig_buf)
             rsk[:n, :64] = np.frombuffer(sig_blob, np.uint8).reshape(n, 64)
             sha = hashlib.sha512

@@ -127,6 +127,14 @@ def _bind(lib) -> None:
         ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
     ]
+    lib.commit_sign_bytes.restype = ctypes.c_long
+    lib.commit_sign_bytes.argtypes = [
+        ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -271,6 +279,36 @@ def commit_parse(buf: bytes):
             (n, flags.raw, addr_lens.raw, addrs.raw, ts_s, ts_n,
              sig_lens.raw, sigs.raw, spans),
         )
+
+
+def commit_sign_bytes(n, flags, ts_s, ts_n, prefix_commit: bytes,
+                      prefix_nil: bytes, tail: bytes):
+    """Canonical sign bytes for all commit slots in one C call.
+
+    flags: uint8 numpy array; ts_s/ts_n: int64 numpy arrays (zero-copy).
+    Returns (blob bytes, lens uint32 numpy array) or None when the lib
+    is absent or a flag is outside ABSENT/COMMIT/NIL."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "commit_sign_bytes"):
+        return None
+    import numpy as _np
+
+    # worst case per slot: 3B length prefix + prefix + 24B ts field + tail
+    cap = int(n) * (max(len(prefix_commit), len(prefix_nil))
+                    + len(tail) + 32)
+    out = _np.empty(cap, _np.uint8)
+    lens = _np.empty(n, _np.uint32)
+    total = lib.commit_sign_bytes(
+        n, flags.ctypes.data_as(ctypes.c_void_p),
+        ts_s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ts_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        prefix_commit, len(prefix_commit), prefix_nil, len(prefix_nil),
+        tail, len(tail), out.ctypes.data_as(ctypes.c_void_p), cap,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    if total < 0:
+        return None
+    return out[:total].tobytes(), lens
 
 
 def merkle_root(items) -> bytes:

@@ -247,6 +247,52 @@ class CommitSig:
         )
 
 
+class _LazySigList:
+    """CommitSig list materialized on first ELEMENT access.
+
+    Natively-decoded commits carry columnar views (Commit.verify_columns)
+    that the batched replay path consumes directly; building 1000
+    CommitSig objects per block cost more than the wire parse itself.
+    Length/truthiness never materialize (validate_block's size checks
+    stay free); iteration, indexing, and equality build the real list
+    once and delegate."""
+
+    __slots__ = ("_n", "_mk", "_real")
+
+    def __init__(self, n: int, mk):
+        self._n = n
+        self._mk = mk
+        self._real = None
+
+    def _mat(self) -> list:
+        if self._real is None:
+            self._real = self._mk()
+            self._mk = None
+        return self._real
+
+    def __len__(self):
+        return self._n
+
+    def __bool__(self):
+        return self._n > 0
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __eq__(self, other):
+        if isinstance(other, _LazySigList):
+            other = other._mat()
+        if isinstance(other, list):
+            return self._mat() == other
+        return NotImplemented
+
+    def __repr__(self):
+        return repr(self._mat())
+
+
 @dataclass
 class Commit:
     """+2/3 precommit evidence for a block (reference types/block.go:835)."""
@@ -351,7 +397,59 @@ class Commit:
             )
         return out
 
+    def verify_columns(self):
+        """Columnar views for batch verification: (flags u8, addrs
+        (n,20) u8, addr_lens u8, sig_lens u8, sigs (n,64) u8, ts_s i64,
+        ts_n i64) numpy arrays, or None when this commit was not decoded through
+        the native columnar parser (wire/store decode is the replay
+        path; hand-built commits take the per-slot path)."""
+        cols = self.__dict__.get("_cols")
+        if cols is None:
+            return None
+        import numpy as np
+
+        n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs = cols
+        return (
+            np.frombuffer(flags, np.uint8, n),
+            np.frombuffer(addrs, np.uint8, n * 20).reshape(n, 20),
+            np.frombuffer(addr_lens, np.uint8, n),
+            np.frombuffer(sig_lens, np.uint8, n),
+            np.frombuffer(sigs, np.uint8, n * 64).reshape(n, 64),
+            np.frombuffer(ts_s, np.int64, n),
+            np.frombuffer(ts_n, np.int64, n),
+        )
+
+    def vote_sign_bytes_blob(self, chain_id: str):
+        """(msgs blob, lens uint32 array) covering every slot (absent
+        slots have length 0), built in one native call from the decode
+        columns — byte-identical to vote_sign_bytes per index. None
+        when columns or the native lib are unavailable."""
+        cols = self.__dict__.get("_cols")
+        if cols is None:
+            return None
+        from ..crypto import native as _native
+
+        import numpy as np
+
+        n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs = cols
+        _, with_bid, nil_bid, tail = self._sb_parts(chain_id)
+        return _native.commit_sign_bytes(
+            n, np.frombuffer(flags, np.uint8, n),
+            np.frombuffer(ts_s, np.int64, n),
+            np.frombuffer(ts_n, np.int64, n),
+            with_bid, nil_bid, tail,
+        )
+
     def encode(self) -> bytes:
+        # memoized: commits are immutable once constructed (decode /
+        # make_commit / VoteSet.make_commit all seal before exposing),
+        # and the hot paths re-encode them constantly — every
+        # save_block, gossip frame, and embedded LastCommit encodes the
+        # same 1000-signature list again. Mutating test factories pop
+        # "_enc_memo" explicitly.
+        memo = self.__dict__.get("_enc_memo")
+        if memo is not None:
+            return memo
         out = (
             pb.f_varint(1, self.height)
             + pb.f_varint(2, self.round)
@@ -359,6 +457,7 @@ class Commit:
         )
         for cs in self.signatures:
             out += pb.f_embedded(4, cs.encode())
+        self.__dict__["_enc_memo"] = out
         return out
 
     @classmethod
@@ -373,30 +472,36 @@ class Commit:
         if parsed is not None:
             h_u64, r_u64, bid_span, cols = parsed
             n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs, spans = cols
-            sig_list = []
-            spans_out = [] if trusted_bytes else None
-            flag_cache = _FLAG_CACHE
-            flag_of = BlockIDFlag
-            ts_of = Timestamp
-            cs_of = CommitSig
-            for i in range(n):
-                a0 = i * 20
-                s0 = i * 64
-                fv = flags[i]
-                fl = flag_cache.get(fv)
-                if fl is None:  # UNKNOWN(0) is falsy; don't use `or`
-                    fl = flag_of(fv)
-                sig_list.append(
-                    cs_of(
-                        fl,
-                        addrs[a0 : a0 + addr_lens[i]],
-                        ts_of(ts_s[i], ts_n[i]),
-                        sigs[s0 : s0 + sig_lens[i]],
+
+            def _mk_sigs():
+                sig_list = []
+                flag_cache = _FLAG_CACHE
+                flag_of = BlockIDFlag
+                ts_of = Timestamp
+                cs_of = CommitSig
+                for i in range(n):
+                    a0 = i * 20
+                    s0 = i * 64
+                    fv = flags[i]
+                    fl = flag_cache.get(fv)
+                    if fl is None:  # UNKNOWN(0) is falsy; don't use `or`
+                        fl = flag_of(fv)
+                    sig_list.append(
+                        cs_of(
+                            fl,
+                            addrs[a0 : a0 + addr_lens[i]],
+                            ts_of(ts_s[i], ts_n[i]),
+                            sigs[s0 : s0 + sig_lens[i]],
+                        )
                     )
-                )
-                if spans_out is not None:
-                    off = spans[2 * i]
-                    spans_out.append(buf[off : off + spans[2 * i + 1]])
+                return sig_list
+
+            spans_out = None
+            if trusted_bytes:
+                spans_out = [
+                    buf[spans[2 * i] : spans[2 * i] + spans[2 * i + 1]]
+                    for i in range(n)
+                ]
             bid_off, bid_len = bid_span
             commit = cls(
                 pb.to_i64(h_u64),
@@ -404,10 +509,16 @@ class Commit:
                 BlockID.decode(buf[bid_off : bid_off + bid_len])
                 if bid_len or bid_off
                 else ZERO_BLOCK_ID,
-                sig_list,
+                _LazySigList(n, _mk_sigs),
             )
             if spans_out is not None:
                 commit.__dict__["_sig_spans"] = spans_out
+            # stash the columnar views for the batch-verify fast path
+            # (replay verifies 1000-signature commits; re-extracting
+            # per-CommitSig fields there costs more than the decode)
+            commit.__dict__["_cols"] = (
+                n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs
+            )
             return commit
         # specialized walk (one pass, no per-sig sub-buffer dicts): the
         # signature list dominates and replay decodes one commit per

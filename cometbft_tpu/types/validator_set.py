@@ -162,6 +162,38 @@ class ValidatorSet:
             self.__dict__["_hash_memo"] = h
         return h
 
+    def ed25519_columns(self):
+        """(addr_rows (n,20) u8, pub_rows (n,32) u8, powers i64) numpy
+        columns for the batch-verify fast path, or None when any key is
+        not ed25519. Memoized — replay verifies the same frozen set for
+        thousands of consecutive commits."""
+        cols = self.__dict__.get("_ed_cols", False)
+        if cols is not False:
+            return cols
+        import numpy as np
+
+        cols = None
+        try:
+            pubs = []
+            for v in self.validators:
+                pk = v.pub_key
+                if pk.type_tag() != "tendermint/PubKeyEd25519":
+                    raise ValueError
+                pubs.append(pk.bytes())
+            n = len(self.validators)
+            cols = (
+                np.frombuffer(
+                    b"".join(v.address for v in self.validators), np.uint8
+                ).reshape(n, 20),
+                np.frombuffer(b"".join(pubs), np.uint8).reshape(n, 32),
+                np.asarray([v.voting_power for v in self.validators],
+                           np.int64),
+            )
+        except ValueError:
+            cols = None
+        self.__dict__["_ed_cols"] = cols
+        return cols
+
     def freeze(self) -> "ValidatorSet":
         """Seal the set against mutation. State snapshots share (alias)
         ValidatorSet objects instead of defensively copying; the safety
@@ -328,6 +360,7 @@ class ValidatorSet:
         self._total_power = None
         self._addr_index = None
         self.__dict__.pop("_hash_memo", None)
+        self.__dict__.pop("_ed_cols", None)
         self.total_voting_power()
         # scale into the priority window, then center (reference order)
         self.rescale_priorities(

@@ -84,11 +84,49 @@ def make_signers(n: int, seed: int = 0) -> list[ScalarSigner]:
     return [ScalarSigner(s, pubs[i].tobytes()) for i, s in enumerate(scalars)]
 
 
-def batch_sign(signers: list[ScalarSigner], msgs: list[bytes], seed: int = 1) -> list[bytes]:
-    """One signature per (signer, msg) pair, R points computed on device."""
-    rng = np.random.default_rng(seed)
-    rs = [int.from_bytes(rng.bytes(32), "little") % ref.L or 1 for _ in signers]
-    r_encs = _fixed_base_batch(rs)
+class RPool:
+    """Pre-batched R nonce points for chunked chain generation.
+
+    batch_sign's per-call _fixed_base_batch pays one device round trip
+    (~150 ms through the tunnel); generating a 50k-block x 1000-signer
+    chain that way spends 2+ hours on round trips alone. The pool
+    computes R encodings for `blocks_per_fill` commits in ONE device
+    call and hands them out per block."""
+
+    def __init__(self, n_signers: int, blocks_per_fill: int = 32,
+                 seed: int = 1):
+        self.n = n_signers
+        self.per_fill = blocks_per_fill
+        self.seed = seed
+        self._buf: list[tuple[list[int], np.ndarray]] = []
+
+    def next(self) -> tuple[list[int], np.ndarray]:
+        if not self._buf:
+            rng = np.random.default_rng(self.seed)
+            self.seed += 1
+            total = self.n * self.per_fill
+            rs = [
+                int.from_bytes(rng.bytes(32), "little") % ref.L or 1
+                for _ in range(total)
+            ]
+            encs = _fixed_base_batch(rs)
+            for i in range(self.per_fill):
+                lo = i * self.n
+                self._buf.append((rs[lo:lo + self.n], encs[lo:lo + self.n]))
+        return self._buf.pop()
+
+
+def batch_sign(signers: list[ScalarSigner], msgs: list[bytes], seed: int = 1,
+               nonces: tuple[list[int], np.ndarray] | None = None) -> list[bytes]:
+    """One signature per (signer, msg) pair, R points computed on device
+    (or taken from a pre-batched RPool draw via `nonces`)."""
+    if nonces is not None:
+        rs, r_encs = nonces
+        rs, r_encs = rs[:len(signers)], r_encs[:len(signers)]
+    else:
+        rng = np.random.default_rng(seed)
+        rs = [int.from_bytes(rng.bytes(32), "little") % ref.L or 1 for _ in signers]
+        r_encs = _fixed_base_batch(rs)
     sigs = []
     for signer, msg, r, r_enc in zip(signers, msgs, rs, r_encs):
         r_b = r_enc.tobytes()
@@ -154,6 +192,11 @@ def make_chain(
     backend: str = "cpu",
     nil_votes: dict[int, set[int]] | None = None,
     corrupt_sig: tuple[int, int] | None = None,
+    verify_last_commit: bool = True,
+    r_pool: "RPool | None" = None,
+    start_state=None,
+    start_commit: Commit | None = None,
+    start_height: int = 1,
 ):
     """Generate a fully-valid signed chain by actually running the executor.
 
@@ -168,6 +211,16 @@ def make_chain(
     into the next block's embedded LastCommit, so verification during
     generation is elided for such chains — they exist to test that replay
     REJECTS them).
+
+    verify_last_commit=False skips LastCommit verification during
+    generation: the commits are signed here and known-valid, and at
+    north-star scale (50k blocks x 1000 validators) re-verifying each
+    one with the pure-Python oracle costs ~4.4 s/block — the REPLAY of
+    the generated store is where verification is measured. r_pool
+    amortizes the device nonce-point round trip over many blocks.
+    start_state/start_commit/start_height continue a chain from a prior
+    make_chain call's (state, last_commit) so arbitrarily long chains
+    build in bounded-memory chunks into one shared block_store.
     """
     from ..abci.client import AppConns
     from ..abci.kvstore import KVStoreApp
@@ -181,10 +234,10 @@ def make_chain(
     store = block_store or BlockStore(MemKV())
     executor = BlockExecutor(AppConns(app), backend=backend)
     genesis = make_genesis_state(chain_id, vals)
-    state = genesis.copy()
+    state = start_state if start_state is not None else genesis.copy()
 
-    last_commit = Commit()
-    for h in range(1, n_blocks + 1):
+    last_commit = start_commit if start_commit is not None else Commit()
+    for h in range(start_height, start_height + n_blocks):
         txs = [b"k%d-%d=v%d" % (h, i, i) for i in range(txs_per_block)]
         proposer = state.validators.get_proposer()
         block = executor.create_proposal_block(
@@ -195,18 +248,23 @@ def make_chain(
         vals_h = state.validators  # the set that signs height h's commit
         state = executor.apply_block(
             state, bid, block,
-            last_commit_preverified=corrupt_sig is not None,
+            last_commit_preverified=(
+                corrupt_sig is not None or not verify_last_commit
+            ),
         )
         commit = make_commit(
             chain_id, h, 0, bid, vals_h, by_addr,
             time_ns=state.last_block_time.unix_ns() + 1_000_000_000,
             nil=(nil_votes or {}).get(h),
+            r_pool=r_pool,
         )
         if corrupt_sig is not None and corrupt_sig[0] == h:
             cs = commit.signatures[corrupt_sig[1]]
             sig = bytearray(cs.signature)
             sig[0] ^= 0xFF
             cs.signature = bytes(sig)
+            commit.__dict__.pop("_enc_memo", None)  # invalidate encode memo
+            commit.__dict__.pop("_hash_memo", None)
         store.save_block(block, commit)
         last_commit = commit
     return store, state, genesis, signers
@@ -223,6 +281,7 @@ def make_commit(
     absent: set[int] | None = None,
     nil: set[int] | None = None,
     sign_seed: int | None = None,
+    r_pool: "RPool | None" = None,
 ) -> Commit:
     """A commit signed by every validator (minus `absent` indices; `nil`
     indices sign a NIL precommit), ordered to match the validator set."""
@@ -254,7 +313,10 @@ def make_commit(
             continue
         msgs[j] = commit.vote_sign_bytes(chain_id, idx)
         j += 1
-    sigs = batch_sign(signers, msgs, seed=(sign_seed if sign_seed is not None else height))
+    sigs = batch_sign(
+        signers, msgs, seed=(sign_seed if sign_seed is not None else height),
+        nonces=r_pool.next() if r_pool is not None else None,
+    )
     j = 0
     for idx in range(len(vals.validators)):
         if sig_slots[idx] is None:
