@@ -185,11 +185,15 @@ def check_abci_grammar(calls: list[str], first_execution: bool = True) -> list[s
     return errors
 
 
-def check_node_log(log_path: str) -> list[str]:
+def check_node_log(log_path: str, clean_start: bool = True) -> list[str]:
     """Check every execution in a node's call log; errors are prefixed
-    with their execution ordinal."""
+    with their execution ordinal. clean_start=False relaxes the
+    first-execution CleanStart requirement — used for nodes whose log
+    begins mid-life (e.g. upgraded from a build that predates
+    recording)."""
     errors = []
     for e_idx, calls in enumerate(read_executions(log_path)):
-        for err in check_abci_grammar(calls, first_execution=(e_idx == 0)):
+        first = e_idx == 0 and clean_start
+        for err in check_abci_grammar(calls, first_execution=first):
             errors.append(f"execution {e_idx}: {err}")
     return errors

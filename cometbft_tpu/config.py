@@ -253,16 +253,38 @@ class Config:
     @classmethod
     def from_toml(cls, raw: str) -> "Config":
         d = tomllib.loads(raw)
+
+        def mk(section_cls, sd):
+            # forward compatibility: a config written by a NEWER build
+            # may carry keys this build does not know; dropping them
+            # (with a warning) instead of crashing is what lets a node
+            # downgrade/upgrade across builds with one config file
+            # (reference viper-based loading is tolerant the same way)
+            from dataclasses import fields as _fields
+
+            known = {f.name for f in _fields(section_cls)}
+            unknown = [k for k in sd if k not in known]
+            if unknown:
+                from .utils.log import logger
+
+                logger("config").warn(
+                    "ignoring unknown config keys",
+                    section=section_cls.__name__,
+                    keys=",".join(sorted(unknown)),
+                )
+            return section_cls(**{k: v for k, v in sd.items() if k in known})
+
         cfg = cls(
-            base=BaseConfig(**d.get("base", {})),
-            rpc=RPCConfig(**d.get("rpc", {})),
-            p2p=P2PConfig(**d.get("p2p", {})),
-            mempool=MempoolConfig(**d.get("mempool", {})),
-            consensus=ConsensusConfig(**d.get("consensus", {})),
-            blocksync=BlockSyncConfig(**d.get("blocksync", {})),
-            statesync=StateSyncConfig(**d.get("statesync", {})),
-            storage=StorageConfig(**d.get("storage", {})),
-            instrumentation=InstrumentationConfig(**d.get("instrumentation", {})),
+            base=mk(BaseConfig, d.get("base", {})),
+            rpc=mk(RPCConfig, d.get("rpc", {})),
+            p2p=mk(P2PConfig, d.get("p2p", {})),
+            mempool=mk(MempoolConfig, d.get("mempool", {})),
+            consensus=mk(ConsensusConfig, d.get("consensus", {})),
+            blocksync=mk(BlockSyncConfig, d.get("blocksync", {})),
+            statesync=mk(StateSyncConfig, d.get("statesync", {})),
+            storage=mk(StorageConfig, d.get("storage", {})),
+            instrumentation=mk(InstrumentationConfig,
+                               d.get("instrumentation", {})),
         )
         cfg.validate()
         return cfg

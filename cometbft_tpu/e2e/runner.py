@@ -46,7 +46,8 @@ def _rpc(port: int, method: str, params: dict | None = None, timeout=3.0):
 
 
 class _ProcNode:
-    def __init__(self, name: str, home: str, rpc_port: int):
+    def __init__(self, name: str, home: str, rpc_port: int,
+                 command: list[str] | None = None):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
@@ -56,6 +57,15 @@ class _ProcNode:
         # perturbation restarts a node as a newer build via
         # COMETBFT_TPU_VERSION
         self.extra_env: dict[str, str] = {}
+        # alternate interpreter/module invocation (e.g. an OLD build
+        # pip-installed in a venv — reference manifest.go Version);
+        # None runs the current repo's build. The "upgrade"
+        # perturbation clears this to swap builds mid-run.
+        self.command = command
+        # true once the node has run under a build that predates the
+        # ABCI call log: its log then starts mid-life, so the grammar
+        # checker must not demand a clean-start first execution
+        self.pre_log_history = False
 
     def start(self) -> None:
         if self.log.closed:  # relaunch after stop_all closed the log
@@ -66,9 +76,9 @@ class _ProcNode:
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
         env.update(self.extra_env)
+        base = self.command or [sys.executable, "-m", "cometbft_tpu.cli"]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "cometbft_tpu.cli",
-             "--home", self.home, "start"],
+            [*base, "--home", self.home, "start"],
             stdout=self.log, stderr=self.log, env=env,
         )
 
@@ -107,12 +117,17 @@ class _ProcNode:
 
 class Runner:
     def __init__(self, manifest: Manifest, workdir: str,
-                 starting_port: int = 0):
+                 starting_port: int = 0,
+                 node_commands: dict[str, list[str]] | None = None):
         self.manifest = manifest
         self.workdir = workdir
         self.starting_port = starting_port or self._free_port_base(
             2 * len(manifest.nodes)
         )
+        # per-node alternate build invocations (mixed-version nets);
+        # environment-specific, so a Runner argument rather than a
+        # manifest field
+        self.node_commands = node_commands or {}
         self.nodes: dict[str, _ProcNode] = {}
         self._load_stop = threading.Event()
         self._load_thread: threading.Thread | None = None
@@ -179,7 +194,10 @@ class Runner:
             cfg.base.snapshot_interval = 2
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
-            self.nodes[spec.name] = _ProcNode(spec.name, home, port)
+            self.nodes[spec.name] = _ProcNode(
+                spec.name, home, port,
+                command=self.node_commands.get(spec.name),
+            )
 
     def _node_id(self, name: str) -> str:
         """Peer id of a testnet node, derived from its generated key
@@ -352,10 +370,16 @@ class Runner:
             self._split(side_a, False)
         elif p.op == "upgrade":
             # restart as a newer build (reference perturb.go's binary
-            # swap): the node comes back advertising a bumped software
-            # version and must keep interoperating with the old-version
-            # peers — NodeInfo compatibility is network+channels only
+            # swap): a node launched from an alternate (older) build
+            # swaps to the CURRENT repo build — wire, store, and WAL
+            # must carry across for the chain to keep committing
+            # through it. Nodes already on the current build restart
+            # advertising a bumped software version (version-skew
+            # interop; NodeInfo compatibility is network+channels only).
             node.stop()
+            if node.command is not None:
+                node.pre_log_history = True
+            node.command = None  # current build from here on
             node.extra_env["COMETBFT_TPU_VERSION"] = "99.0.0-e2e-upgrade"
             node.start()
         else:
@@ -476,7 +500,9 @@ class Runner:
         counts = {}
         for name, n in self.nodes.items():
             log_path = os.path.join(n.home, "data", "abci_calls.log")
-            errs = check_node_log(log_path)
+            errs = check_node_log(
+                log_path, clean_start=not n.pre_log_history
+            )
             if errs:
                 raise E2EError(
                     f"ABCI grammar violations on {name}: " + "; ".join(errs)

@@ -101,6 +101,12 @@ def _median_time_columnar(commit: Commit, vals: ValidatorSet):
     live = flags != 1
     if not (addrs[live] == addr_rows[live]).all():
         return None  # out-of-order/unknown addresses: slow path
+    # int64 ns math wraps beyond +-292 years from epoch (e.g. the Go
+    # zero time, seconds = -62135596800); the scalar walk uses exact
+    # Python ints, so out-of-range timestamps take the slow path rather
+    # than risk a divergent median
+    if len(ts_s) and (np.abs(ts_s[live]) > 9_000_000_000).any():
+        return None
     ts = ts_s[live] * 1_000_000_000 + ts_n[live]
     pw = powers[live]
     if not len(ts):

@@ -440,13 +440,26 @@ class Commit:
             with_bid, nil_bid, tail,
         )
 
+    def invalidate_memos(self) -> None:
+        """Drop every derived-bytes memo (encode, hash, sign-bytes
+        parts, decode columns, spans). Commits are immutable on every
+        production path — decode, make_commit, and VoteSet.make_commit
+        all seal before exposing — so only code that mutates a
+        CommitSig in place afterwards (test factories, corruption
+        harnesses) must call this, or stale memoized bytes will be
+        served."""
+        d = self.__dict__
+        for k in ("_enc_memo", "_hash_memo", "_cols", "_sig_spans",
+                  "_sb_cache"):
+            d.pop(k, None)
+
     def encode(self) -> bytes:
         # memoized: commits are immutable once constructed (decode /
         # make_commit / VoteSet.make_commit all seal before exposing),
         # and the hot paths re-encode them constantly — every
         # save_block, gossip frame, and embedded LastCommit encodes the
-        # same 1000-signature list again. Mutating test factories pop
-        # "_enc_memo" explicitly.
+        # same 1000-signature list again. In-place mutators must call
+        # invalidate_memos().
         memo = self.__dict__.get("_enc_memo")
         if memo is not None:
             return memo

@@ -263,8 +263,7 @@ def make_chain(
             sig = bytearray(cs.signature)
             sig[0] ^= 0xFF
             cs.signature = bytes(sig)
-            commit.__dict__.pop("_enc_memo", None)  # invalidate encode memo
-            commit.__dict__.pop("_hash_memo", None)
+            commit.invalidate_memos()
         store.save_block(block, commit)
         last_commit = commit
     return store, state, genesis, signers

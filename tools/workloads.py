@@ -285,8 +285,7 @@ def bench_megacommit_mixed(n_vals=10_000, n_sr=1000, n_secp=500, reps=5):
         [ed_by_addr[vals.validators[i].address] for i in ed_idx], ed_msgs)
     for i, sig in zip(ed_idx, ed_sigs):
         commit.signatures[i].signature = sig
-    commit.__dict__.pop("_enc_memo", None)
-    commit.__dict__.pop("_hash_memo", None)
+    commit.invalidate_memos()
 
     verify_commit(chain_id, vals, bid, height, commit)  # warmup/compile
     times = []
