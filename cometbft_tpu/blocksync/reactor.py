@@ -130,6 +130,8 @@ class BlockSyncReactor(Reactor):
         # learn peer ranges
         with self._lock:
             peers = list(self._peers.values())
+        _log.debug("block sync starting", from_height=state.last_block_height + 1,
+                   peers=len(peers))
         for p in peers:
             p.send(BLOCKSYNC_CHANNEL, encode_status_request())
         start = _time.monotonic()
@@ -143,6 +145,7 @@ class BlockSyncReactor(Reactor):
                     break  # nothing (more) to fetch
                 if (self.pool.max_peer_height() == 0
                         and _time.monotonic() - start > 3.0):
+                    _log.debug("block sync: no peer reported a range")
                     break  # no peer ever reported a range
                 self.pool.wait_for_blocks(poll_s)
                 continue
@@ -168,6 +171,8 @@ class BlockSyncReactor(Reactor):
             self.pool.pop_request()
             applied += 1
         self.state = state
+        _log.debug("block sync done", applied=applied,
+                   height=state.last_block_height)
         if self.on_caught_up is not None:
             self.on_caught_up(state)
         return state

@@ -65,6 +65,15 @@ def cmd_start(args) -> int:
     from .node import Node
 
     p = _cfg_paths(args.home)
+    spec = os.environ.get("COMETBFT_TPU_LOG")
+    if spec:
+        from .utils.log import set_level
+
+        try:
+            set_level(spec)
+        except ValueError as e:
+            # a diagnostic knob typo must not keep the node down
+            print(f"ignoring COMETBFT_TPU_LOG: {e}", file=sys.stderr)
     cfg = Config.load(p["config_file"])
     cfg.base.home = args.home
     app = (

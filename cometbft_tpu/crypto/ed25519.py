@@ -53,7 +53,7 @@ BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
 # only where the host packer is not the binding stage (multi-core
 # hosts or a future native packer).
 RLC_MIN = 4096
-_DEV_LADDER_US = 4.5   # measured e2e device time per signature (r4)
+_DEV_LADDER_US = 2.39  # measured device-resident pipelined (r5, PROFILE.md)
 _DEV_RLC_US = 2.11     # measured xprof device total (r5, PROFILE.md)
 _HOST_RLC_US = 20.0    # rlc.prepare per sig, 1 numpy core (r5 measured)
 _HOST_LADDER_US = 1.6  # ladder submit packing per sig (r4: ~15-22 ms/10k)

@@ -351,7 +351,30 @@ class Node:
         # catch up over block sync before consensus when we have peers
         # that are ahead (reference SwitchToConsensus hand-off); sync()
         # itself drives the status exchange and gives up after 3 s when
-        # no peer ever reports a range, so no pre-sleep is needed
+        # no peer ever reports a range — but it never RUNS unless a
+        # peer is connected when we look, hence the short wait below
+        if (
+            self.config.blocksync.enable
+            and not self.switch.peers()
+            and self.config.p2p.persistent_peer_list()
+        ):
+            # a restarting node checks for peers microseconds after the
+            # switch starts dialing — losing that race silently skipped
+            # block sync on EVERY restart and left catch-up to the
+            # consensus reactor's per-peer gossip (observed: 100% of
+            # restarts skipped; rarely the gossip path stalls). When
+            # peers are configured, give the first dial a moment.
+            import time as _time
+
+            from ..utils.log import logger as _logger
+
+            deadline = _time.monotonic() + 2.0
+            while not self.switch.peers() and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            if not self.switch.peers():
+                _logger("node").debug(
+                    "block sync skipped: no peer connected within 2s"
+                )
         if self.config.blocksync.enable and self.switch.peers():
             from ..utils.log import logger as _logger
 
