@@ -67,13 +67,22 @@ def cmd_start(args) -> int:
     p = _cfg_paths(args.home)
     spec = os.environ.get("COMETBFT_TPU_LOG")
     if spec:
-        from .utils.log import set_level
+        from .utils.log import _LEVELS, set_level
 
-        try:
-            set_level(spec)
-        except ValueError as e:
+        # validate the WHOLE spec before applying any of it: set_level
+        # mutates per-segment, and a partial apply with an "ignoring"
+        # message would silently leave earlier segments active
+        parts = [s.strip() for s in spec.split(",") if s.strip()]
+        bad = [
+            s for s in parts
+            if (s.partition(":")[2] or s) not in _LEVELS
+        ]
+        if bad:
             # a diagnostic knob typo must not keep the node down
-            print(f"ignoring COMETBFT_TPU_LOG: {e}", file=sys.stderr)
+            print(f"ignoring COMETBFT_TPU_LOG (bad level in {bad})",
+                  file=sys.stderr)
+        else:
+            set_level(spec)
     cfg = Config.load(p["config_file"])
     cfg.base.home = args.home
     app = (

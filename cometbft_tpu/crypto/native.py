@@ -127,6 +127,10 @@ def _bind(lib) -> None:
         ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
     ]
+    lib.edwards_msm_is_identity.restype = ctypes.c_int
+    lib.edwards_msm_is_identity.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
     lib.commit_sign_bytes.restype = ctypes.c_long
     lib.commit_sign_bytes.argtypes = [
         ctypes.c_uint64, ctypes.c_void_p,
@@ -279,6 +283,29 @@ def commit_parse(buf: bytes):
             (n, flags.raw, addr_lens.raw, addrs.raw, ts_s, ts_n,
              sig_lens.raw, sigs.raw, spans),
         )
+
+
+def edwards_msm_is_identity(pairs) -> bool | None:
+    """sum [k_i]P_i lands in the RISTRETTO identity coset — the
+    4-torsion {(0,1), (0,-1), (+-i,0)}, checked as T == 0 — via one
+    native Pippenger call. NOT an exact Edwards identity check: do not
+    reuse for cofactored ed25519 equations, where accepting torsion is
+    a forgery vector (those go through ed25519_batch_verify, which
+    multiplies by 8). `pairs` is a list of (k int, (x int, y int))
+    with points already decoded/validated by the caller (the sr25519
+    ristretto batch). None when the lib is absent (caller uses the
+    Python MSM)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "edwards_msm_is_identity"):
+        return None
+    n = len(pairs)
+    xs = b"".join(p[1][0].to_bytes(32, "little") for p in pairs)
+    ys = b"".join(p[1][1].to_bytes(32, "little") for p in pairs)
+    ks = b"".join((p[0] % _L_ORDER).to_bytes(32, "little") for p in pairs)
+    return bool(lib.edwards_msm_is_identity(n, xs, ys, ks))
+
+
+_L_ORDER = 2**252 + 27742317777372353535851937790883648493
 
 
 def commit_sign_bytes(n, flags, ts_s, ts_n, prefix_commit: bytes,

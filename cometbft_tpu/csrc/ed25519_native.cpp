@@ -856,6 +856,58 @@ int ed25519_engine(void) {
     return 0;
 }
 
+// Generic Edwards multi-scalar multiplication RISTRETTO-identity check:
+//   sum [k_i] P_i in the identity coset of ristretto255.
+// P_i arrive as affine (x, y) 32-byte LE field elements (the caller —
+// e.g. the sr25519 ristretto batch, crypto/sr25519.py — has already
+// decoded and validated them; negation is the caller's x -> -x).
+// Plain Pippenger, window c=8. The identity coset is the 4-torsion
+// {(0,1), (0,-1), (+-i, 0)}, i.e. affine x*y == 0 — in extended
+// coordinates exactly T == 0 (X*Y = Z*T, Z != 0). An exact-identity
+// check would reject ~half of all VALID sr25519 batches: each
+// signature equation holds only up to torsion on coset
+// representatives (see crypto/sr25519.py _verify_rlc).
+int edwards_msm_is_identity(u64 n, const u8 *xs, const u8 *ys,
+                            const u8 *scalars) {
+    ge::init_constants();
+    if (n == 0) return 0;
+    const int C = 8, NBK = (1 << C) - 1, NW = 32;
+    std::vector<ge::P> pts(n);
+    for (u64 i = 0; i < n; i++) {
+        fe::from_bytes(&pts[i].x, xs + i * 32);
+        fe::from_bytes(&pts[i].y, ys + i * 32);
+        fe::set1(&pts[i].z);
+        fe::mul(&pts[i].t, &pts[i].x, &pts[i].y);
+    }
+    ge::P acc;
+    ge::identity(&acc);
+    std::vector<ge::P> buckets(NBK);
+    for (int w = NW - 1; w >= 0; w--) {
+        for (int b = 0; b < NBK; b++) ge::identity(&buckets[b]);
+        bool any = false;
+        for (u64 i = 0; i < n; i++) {
+            int d = scalars[i * 32 + w];
+            if (d) {
+                ge::add(&buckets[d - 1], &buckets[d - 1], &pts[i]);
+                any = true;
+            }
+        }
+        if (w != NW - 1)
+            for (int k = 0; k < C; k++) ge::dbl(&acc, &acc);
+        if (!any) continue;
+        // sum_d d * bucket[d-1] via suffix running sums
+        ge::P running, total;
+        ge::identity(&running);
+        ge::identity(&total);
+        for (int b = NBK - 1; b >= 0; b--) {
+            ge::add(&running, &running, &buckets[b]);
+            ge::add(&total, &total, &running);
+        }
+        ge::add(&acc, &acc, &total);
+    }
+    return fe::is_zero(&acc.t);
+}
+
 // verify: ZIP-215. Returns 1 valid, 0 invalid.
 int ed25519_verify(const u8 *pub, const u8 *msg, u64 msg_len, const u8 *sig) {
 #ifdef ED25519_HAVE_IFMA
