@@ -485,6 +485,15 @@ class Commit:
         if parsed is not None:
             h_u64, r_u64, bid_span, cols = parsed
             n, flags, addr_lens, addrs, ts_s, ts_n, sig_lens, sigs, spans = cols
+            # flag validation must stay DECODE-time even though the
+            # CommitSig objects are lazy: the pure-Python walk raises
+            # ValueError on an out-of-range flag while parsing, and
+            # native/non-native builds must reject identical bytes
+            # identically (test_commit_codec_diff pins this)
+            if n and max(flags[:n]) > 3:
+                raise ValueError(
+                    f"{max(flags[:n])} is not a valid BlockIDFlag"
+                )
 
             def _mk_sigs():
                 sig_list = []
