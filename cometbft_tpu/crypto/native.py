@@ -127,6 +127,8 @@ def _bind(lib) -> None:
         ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
     ]
+    lib.keccak_f1600.restype = None
+    lib.keccak_f1600.argtypes = [ctypes.c_void_p]
     lib.edwards_msm_is_identity.restype = ctypes.c_int
     lib.edwards_msm_is_identity.argtypes = [
         ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -283,6 +285,30 @@ def commit_parse(buf: bytes):
             (n, flags.raw, addr_lens.raw, addrs.raw, ts_s, ts_n,
              sig_lens.raw, sigs.raw, spans),
         )
+
+
+_KECCAK_FN = None  # resolved once: the permutation runs ~6k times per
+# sr25519 batch and get_lib's lock + hasattr per call cost more than
+# the C permutation itself
+
+
+def keccak_f1600(state: bytearray) -> bool:
+    """In-place Keccak-f[1600] on a 200-byte state; False when the lib
+    is absent (caller runs the Python permutation)."""
+    global _KECCAK_FN
+    fn = _KECCAK_FN
+    if fn is None:
+        lib = get_lib()
+        fn = _KECCAK_FN = (
+            lib.keccak_f1600
+            if lib is not None and hasattr(lib, "keccak_f1600")
+            else False
+        )
+    if fn is False:
+        return False
+    buf = (ctypes.c_char * 200).from_buffer(state)
+    fn(ctypes.addressof(buf))
+    return True
 
 
 def edwards_msm_is_identity(pairs) -> bool | None:

@@ -29,6 +29,9 @@ void ed25519_batch_k(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
                      const u64 *msg_lens, u8 *out);
 void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
                       const u64 *msg_lens, u8 *out_rsk);
+void keccak_f1600(u8 *state);
+int edwards_msm_is_identity(u64 n, const u8 *xs, const u8 *ys,
+                            const u8 *scalars);
 void merkle_root_native(u64 n, const u8 *blob, const u64 *offs, u8 *out32);
 void sha256_oneshot(const u8 *data, u64 len, u8 *out32);
 long commit_parse(const u8 *buf, u64 len, u64 cap, u64 *head, u8 *flags,
@@ -109,6 +112,18 @@ static int new_surface_checks() {
                 return 1;
             }
         }
+    }
+    // --- keccak permutation + generic MSM (bounds only; logic is
+    // covered by the Python differential suites)
+    {
+        u8 st[200];
+        for (int i = 0; i < 200; i++) st[i] = lcg();
+        for (int r = 0; r < 8; r++) keccak_f1600(st);
+        std::vector<u8> xs(7 * 32), ys(7 * 32), ks(7 * 32);
+        for (auto *v : {&xs, &ys, &ks})
+            for (auto &b : *v) b = lcg() & 0x3f;
+        edwards_msm_is_identity(7, xs.data(), ys.data(), ks.data());
+        edwards_msm_is_identity(0, xs.data(), ys.data(), ks.data());
     }
     // --- commit_parse: synthesized valid-ish wire, then mutation fuzz
     {
