@@ -66,6 +66,15 @@ class RecordingApp:
             if self._fh is not None:
                 self._fh.write(name + "\n")
 
+    def close(self) -> None:
+        """Release the call-log fd; long-lived embedders that build many
+        nodes would otherwise leak one fd per RecordingApp. Idempotent;
+        records after close() still land in `calls`."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
     def __getattr__(self, name):
         fn = getattr(self._app, name)
         if callable(fn) and name in GRAMMAR_CALLS:

@@ -85,6 +85,10 @@ def cmd_start(args) -> int:
             set_level(spec)
     cfg = Config.load(p["config_file"])
     cfg.base.home = args.home
+    if getattr(args, "seed_mode", False):
+        # flag overrides config (reference --p2p.seed_mode)
+        cfg.p2p.seed_mode = True
+        cfg.validate()
     app = (
         KVStoreApp(snapshot_interval=cfg.base.snapshot_interval)
         if cfg.base.abci == "local" else None
@@ -101,15 +105,22 @@ def cmd_start(args) -> int:
 
 
 def cmd_testnet(args) -> int:
-    """reference commands/testnet.go: N validator homes + shared genesis."""
+    """reference commands/testnet.go: N validator homes + shared genesis.
+
+    With --seed-nodes K, K extra seed-mode homes (node{v}..node{v+K-1},
+    NOT in the genesis validator set) follow the validator homes, and
+    the validators get `p2p.seeds` pointing at them with NO persistent
+    peers — the seed-only bootstrap topology the e2e runner exercises."""
     from .config import Config
     from .privval import FilePV
     from .types import Timestamp
     from .types.genesis import GenesisDoc, GenesisValidator
 
+    n_seeds = getattr(args, "seed_nodes", 0)
+    total = args.v + n_seeds
     pvs = []
     homes = []
-    for i in range(args.v):
+    for i in range(total):
         home = os.path.join(args.output, f"node{i}")
         p = _cfg_paths(home)
         os.makedirs(p["config"], exist_ok=True)
@@ -121,24 +132,40 @@ def cmd_testnet(args) -> int:
         genesis_time=Timestamp.from_unix_ns(time.time_ns()),
         validators=[
             GenesisValidator(pv.pub_key().bytes(), 10, f"node{i}")
-            for i, pv in enumerate(pvs)
+            for i, pv in enumerate(pvs[:args.v])
         ],
     )
     base_p2p = args.starting_port
+    seed_addrs = [
+        f"127.0.0.1:{base_p2p + 2 * (args.v + k)}" for k in range(n_seeds)
+    ]
     for i, home in enumerate(homes):
         p = _cfg_paths(home)
+        is_seed = i >= args.v
         cfg = Config()
         cfg.base.home = home
         cfg.base.chain_id = args.chain_id
         cfg.base.moniker = f"node{i}"
         cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i + 1}"
-        cfg.p2p.persistent_peers = ",".join(
-            f"127.0.0.1:{base_p2p + 2 * j}" for j in range(args.v) if j != i
-        )
+        if is_seed:
+            cfg.p2p.seed_mode = True
+            # a seed may crawl its fellow seeds to widen its book
+            cfg.p2p.seeds = ",".join(
+                a for k, a in enumerate(seed_addrs) if k != i - args.v
+            )
+        elif n_seeds:
+            # seed-only bootstrap: discovery must come through PEX
+            cfg.p2p.seeds = ",".join(seed_addrs)
+        else:
+            cfg.p2p.persistent_peers = ",".join(
+                f"127.0.0.1:{base_p2p + 2 * j}"
+                for j in range(args.v) if j != i
+            )
         cfg.save(p["config_file"])
         gd.save(p["genesis"])
-    print(f"generated {args.v} validator homes under {args.output}")
+    extra = f" + {n_seeds} seed homes" if n_seeds else ""
+    print(f"generated {args.v} validator homes{extra} under {args.output}")
     return 0
 
 
@@ -421,9 +448,16 @@ def main(argv=None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("init");  sp.add_argument("--chain-id", default="local-chain"); sp.set_defaults(fn=cmd_init)
-    sp = sub.add_parser("start"); sp.set_defaults(fn=cmd_start)
+    sp = sub.add_parser("start")
+    sp.add_argument("--seed-mode", action="store_true",
+                    help="run as a seed-crawler (overrides p2p.seed_mode)")
+    sp.set_defaults(fn=cmd_start)
     sp = sub.add_parser("testnet")
     sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--seed-nodes", type=int, default=0,
+                    help="extra non-validator seed-mode homes; validators "
+                         "then bootstrap via p2p.seeds instead of "
+                         "persistent_peers")
     sp.add_argument("--output", default="./testnet")
     sp.add_argument("--chain-id", default="testnet-chain")
     sp.add_argument("--starting-port", type=int, default=26656)

@@ -10,7 +10,12 @@ pure-Python oracle (SURVEY §5.6's `crypto.backend` gate).
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
+
 from dataclasses import asdict, dataclass, field
 
 
@@ -75,6 +80,19 @@ class P2PConfig:
     persistent_peers: str = ""  # comma-separated host:port
     pex: bool = True
     addr_book_file: str = "config/addrbook.json"
+    # refuse non-routable addresses in the book (reference
+    # addr_book_strict). Off by default: this reproduction's nets run
+    # on loopback, which strict mode would reject wholesale.
+    addr_book_strict: bool = False
+    # seed-crawler mode (reference p2p.seed_mode): crawl addresses,
+    # serve addrs-on-request, never hold full peers
+    seed_mode: bool = False
+    # comma-separated host:port seed nodes dialed when the address book
+    # cannot supply peers (reference p2p.seeds)
+    seeds: str = ""
+    # cadence of the PEX ensure-peers loop (or the crawl loop in seed
+    # mode); e2e nets tighten this for fast seed-only bootstrap
+    pex_interval_s: float = 30.0
     max_inbound_peers: int = 40
     max_outbound_peers: int = 10
     send_rate: int = 512_000  # bytes/s (reference 500 KB/s default)
@@ -87,13 +105,24 @@ class P2PConfig:
     def validate(self) -> None:
         if self.max_inbound_peers < 0 or self.max_outbound_peers < 0:
             raise ValueError("peer limits must be >= 0")
+        if self.pex_interval_s <= 0:
+            raise ValueError("pex_interval_s must be positive")
+        if self.seed_mode and not self.pex:
+            raise ValueError("seed_mode requires pex")
 
-    def persistent_peer_list(self) -> list[tuple[str, int]]:
+    @staticmethod
+    def _addr_list(raw: str) -> list[tuple[str, int]]:
         out = []
-        for item in filter(None, self.persistent_peers.split(",")):
+        for item in filter(None, raw.split(",")):
             host, port = item.strip().rsplit(":", 1)
             out.append((host, int(port)))
         return out
+
+    def persistent_peer_list(self) -> list[tuple[str, int]]:
+        return self._addr_list(self.persistent_peers)
+
+    def seed_list(self) -> list[tuple[str, int]]:
+        return self._addr_list(self.seeds)
 
 
 @dataclass

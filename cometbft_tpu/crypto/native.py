@@ -41,7 +41,10 @@ def _build() -> bool:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         return False
-    cmd = ["g++", "-O3", "-march=native", "-pthread", "-fPIC", "-shared", "-o", _SO, src]
+    # -std=c++17 explicitly: the IFMA engine uses std::shared_mutex and
+    # g++ <= 10 still defaults to gnu++14, which fails the whole build
+    cmd = ["g++", "-std=c++17", "-O3", "-march=native", "-pthread",
+           "-fPIC", "-shared", "-o", _SO, src]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         return proc.returncode == 0 and os.path.exists(_SO)

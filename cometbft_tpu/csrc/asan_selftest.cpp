@@ -123,7 +123,12 @@ static int new_surface_checks() {
         for (auto *v : {&xs, &ys, &ks})
             for (auto &b : *v) b = lcg() & 0x3f;
         edwards_msm_is_identity(7, xs.data(), ys.data(), ks.data());
-        edwards_msm_is_identity(0, xs.data(), ys.data(), ks.data());
+        // n == 0: the empty sum is the identity — must report 1, and
+        // must never read the (irrelevant) input pointers
+        if (edwards_msm_is_identity(0, xs.data(), ys.data(), ks.data()) != 1) {
+            printf("edwards_msm_is_identity(0) != 1\n");
+            return 1;
+        }
     }
     // --- commit_parse: synthesized valid-ish wire, then mutation fuzz
     {

@@ -914,10 +914,13 @@ void keccak_f1600(u8 *state) {
 // check would reject ~half of all VALID sr25519 batches: each
 // signature equation holds only up to torsion on coset
 // representatives (see crypto/sr25519.py _verify_rlc).
+// Precondition: xs/ys/scalars each hold n 32-byte elements. n == 0 is
+// legal and returns 1: the empty sum IS the identity (a zero-signature
+// batch verifies vacuously, matching the Python oracle's behavior).
 int edwards_msm_is_identity(u64 n, const u8 *xs, const u8 *ys,
                             const u8 *scalars) {
     ge::init_constants();
-    if (n == 0) return 0;
+    if (n == 0) return 1;  // empty sum is the identity element
     const int C = 8, NBK = (1 << C) - 1, NW = 32;
     std::vector<ge::P> pts(n);
     for (u64 i = 0; i < n; i++) {

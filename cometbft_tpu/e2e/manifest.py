@@ -23,6 +23,12 @@ class NodeSpec:
     # (reference manifest.go StateSync); implies a late start — the
     # runner anchors trust at a live node's header at join time
     state_sync: bool = False
+    # seed-crawler node (reference manifest.go Mode "seed"): not in the
+    # genesis validator set; the other nodes bootstrap from it via PEX
+    # with no persistent peers. Seed specs must come LAST in the node
+    # list (homes are positional: the testnet generator puts seed homes
+    # after validator homes).
+    seed: bool = False
 
 
 @dataclass
@@ -98,8 +104,14 @@ def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
     # via block sync, or via state sync when the draw says so — joining
     # mid-run exercises the catchup paths a genesis start never does.
     # Only nets with >= 3 genesis validators get one, so the quorum
-    # does not depend on the joiner.
-    if n_nodes >= 3 and rng.random() < 0.5:
+    # does not depend on the joiner. A third draw instead appends a
+    # seed node and strips every validator's persistent peers: the net
+    # must then assemble itself purely through PEX discovery
+    # (seed-only bootstrap, reference generate.go's seed topologies).
+    topo = rng.random()
+    if n_nodes >= 3 and topo < 0.3:
+        nodes.append(NodeSpec(name=f"node{n_nodes}", seed=True))
+    elif n_nodes >= 3 and topo < 0.65:
         nodes.append(NodeSpec(
             name=f"node{n_nodes}",
             power=10,
@@ -114,7 +126,10 @@ def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
     # other op, upgrade included, is safe at any size. Late joiners are
     # not perturbed: their catchup IS the perturbation (but they may
     # overlap one on another node — generate.go mixes these freely).
-    genesis_nodes = [n for n in nodes if n.start_at == 0]
+    # Seed nodes are never perturbed either: killing the seed AFTER
+    # bootstrap proves nothing (discovery already happened) and killing
+    # it before is just a dead net.
+    genesis_nodes = [n for n in nodes if n.start_at == 0 and not n.seed]
     for k in range(rng.choice([1, 2])):
         op = rng.choice(
             ops if len(genesis_nodes) >= 3

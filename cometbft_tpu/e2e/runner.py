@@ -154,8 +154,19 @@ class Runner:
         from ..config import Config
 
         m = self.manifest
+        # homes are positional (node{i} = m.nodes[i]) and the testnet
+        # generator emits seed homes after validator homes, so seed
+        # specs must come last in the manifest
+        n_seeds = sum(1 for s in m.nodes if s.seed)
+        n_validators = len(m.nodes) - n_seeds
+        if any(s.seed for s in m.nodes[:n_validators]):
+            raise E2EError("seed nodes must come last in manifest.nodes")
+        if any(s.seed and (s.start_at or s.state_sync) for s in m.nodes):
+            raise E2EError("seed nodes start with the net (no late join)")
         rc = cli_main([
-            "testnet", "--v", str(len(m.nodes)), "--output", self.workdir,
+            "testnet", "--v", str(n_validators),
+            "--seed-nodes", str(n_seeds),
+            "--output", self.workdir,
             "--chain-id", m.chain_id,
             "--starting-port", str(self.starting_port),
         ])
@@ -187,6 +198,9 @@ class Runner:
             cfg.consensus.timeout_precommit_delta = 0.1
             cfg.consensus.timeout_commit = m.timeout_commit
             cfg.p2p.fault_injection = True  # arm the partition channel
+            # fast PEX cadence so a seed-only bootstrap converges well
+            # inside the test budget (discovery needs a few round trips)
+            cfg.p2p.pex_interval_s = 0.5
             # record ABCI call sequences for the post-run conformance
             # check (reference test/e2e/pkg/grammar/checker.go)
             cfg.base.abci_call_log = True
@@ -229,7 +243,11 @@ class Runner:
         compute per-tx commit latency from block times alone."""
         i = 0
         interval = 1.0 / self.manifest.tx_rate
-        nodes = list(self.nodes.values())
+        # never target seed nodes: a seed holds no full peers, so a tx
+        # sent to it has no gossip path and would silently vanish
+        nodes = [
+            n for name, n in self.nodes.items() if not self._spec(name).seed
+        ]
         while not self._load_stop.is_set():
             node = nodes[i % len(nodes)]
             t_ns = time.time_ns()
@@ -297,8 +315,46 @@ class Runner:
             "max_s": round(lats[-1], 4),
         }
 
+    def sample_peer_counts(self, name: str, samples: int = 6,
+                           interval_s: float = 0.5) -> list[int]:
+        """Poll `name`'s net_info peer count (reference /net_info
+        n_peers). A seed-mode node crawls-and-disconnects, so sampled
+        over time its count must keep RETURNING to zero — the
+        observable difference from a node holding full peers."""
+        counts = []
+        node = self.nodes[name]
+        for _ in range(samples):
+            try:
+                r = _rpc(node.rpc_port, "net_info")
+                counts.append(int(r["n_peers"]))
+            except Exception:  # noqa: BLE001 — node may be perturbed
+                counts.append(-1)
+            time.sleep(interval_s)
+        return counts
+
+    def addrbook_doc(self, name: str) -> dict:
+        """Parse `name`'s persisted address book (written on node stop
+        and on every pex tick) for post-run assertions."""
+        path = os.path.join(
+            self.nodes[name].home, "config", "addrbook.json"
+        )
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
     def max_height(self) -> int:
-        return max((n.height() for n in self.nodes.values()), default=-1)
+        return max(
+            (n.height() for name, n in self.nodes.items()
+             if not self._spec(name).seed),
+            default=-1,
+        )
+
+    def _spec(self, name: str):
+        for s in self.manifest.nodes:
+            if s.name == name:
+                return s
+        raise E2EError(f"unknown node {name}")
 
     def wait_for_height(self, h: int, timeout_s: float) -> None:
         deadline = time.monotonic() + timeout_s

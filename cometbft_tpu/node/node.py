@@ -62,6 +62,7 @@ class Node:
         )
 
         # --- app conns -------------------------------------------------
+        self._recording_app = None
         if config.base.abci_call_log and config.base.abci == "local" and app is not None:
             # conformance recording (reference test/e2e/pkg/grammar):
             # every grammar-relevant ABCI call appends to data/ so the
@@ -69,6 +70,7 @@ class Node:
             from ..abci.grammar import RecordingApp
 
             app = RecordingApp(app, _p("data/abci_calls.log"))
+            self._recording_app = app
         if config.base.abci == "grpc":
             from ..abci.grpc_transport import GrpcAppConns
 
@@ -240,13 +242,21 @@ class Node:
         self.switch.add_reactor(self.blocksync_reactor)
         self.switch.add_reactor(self.statesync_reactor)
         self.pex_reactor = None
+        self.addr_book = None
         if config.p2p.pex:
             from ..p2p.pex import AddrBook, PexReactor
 
-            self.addr_book = AddrBook(_p(config.p2p.addr_book_file))
+            self.addr_book = AddrBook(
+                _p(config.p2p.addr_book_file),
+                strict=config.p2p.addr_book_strict,
+                self_id=self.node_key.node_id(),
+            )
             self.pex_reactor = PexReactor(
                 self.addr_book,
                 target_outbound=config.p2p.max_outbound_peers,
+                ensure_interval_s=config.p2p.pex_interval_s,
+                seed_mode=config.p2p.seed_mode,
+                seeds=config.p2p.seed_list(),
             )
             self.pex_reactor.set_switch(self.switch)
             self.switch.add_reactor(self.pex_reactor)
@@ -483,7 +493,7 @@ class Node:
         self.consensus.stop()
         self.pruner.stop()
         if self.pex_reactor is not None:
-            self.pex_reactor.stop()
+            self.pex_reactor.stop()  # also persists the address book
         self.consensus_reactor.stop()
         self.evidence_reactor.stop()
         self.switch.stop()
@@ -498,6 +508,8 @@ class Node:
             self.grpc_privileged_server.stop()
         if hasattr(self.priv_validator, "close"):
             self.priv_validator.close()  # remote signer listener
+        if self._recording_app is not None:
+            self._recording_app.close()  # flush + release the call log fd
 
 
 def bootstrap_state(config: Config, height: int = 0,

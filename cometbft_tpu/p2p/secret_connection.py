@@ -21,18 +21,26 @@ wire format (our p2p layer only speaks to itself).
 from __future__ import annotations
 
 import hashlib
+import hmac
 import struct
 import threading
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # fall back to the in-repo primitives
+    _HAVE_CRYPTOGRAPHY = False
 
 from ..crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+from ..crypto import x25519 as _x25519
+from ..crypto.symmetric import chacha20poly1305_open, chacha20poly1305_seal
 
 DATA_LEN_SIZE = 4
 DATA_MAX_SIZE = 1024
@@ -43,6 +51,68 @@ SEALED_FRAME_SIZE = FRAME_SIZE + TAG_SIZE
 
 class AuthError(Exception):
     pass
+
+
+# -- primitive seams ----------------------------------------------------
+# `cryptography` (OpenSSL-backed) when installed; otherwise the repo's
+# pure-Python ChaCha20-Poly1305 (crypto/symmetric.py), RFC 7748 X25519
+# (crypto/x25519.py), and an HKDF-SHA256 built on stdlib hmac. Both
+# paths compute the same bytes, so mixed deployments interoperate.
+
+def _x25519_keypair():
+    """-> (opaque private handle, 32-byte public key)."""
+    if _HAVE_CRYPTOGRAPHY:
+        priv = X25519PrivateKey.generate()
+        return priv, priv.public_key().public_bytes_raw()
+    priv = _x25519.generate_private()
+    return priv, _x25519.public_from_private(priv)
+
+
+def _x25519_exchange(priv, their_pub: bytes) -> bytes:
+    if _HAVE_CRYPTOGRAPHY:
+        return priv.exchange(X25519PublicKey.from_public_bytes(their_pub))
+    return _x25519.shared_secret(priv, their_pub)
+
+
+def _hkdf_sha256(ikm: bytes, length: int, info: bytes) -> bytes:
+    if _HAVE_CRYPTOGRAPHY:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=length, salt=None, info=info
+        ).derive(ikm)
+    # RFC 5869 with the null salt expanded to HashLen zero bytes
+    prk = hmac.new(b"\x00" * 32, ikm, hashlib.sha256).digest()
+    okm, block, ctr = b"", b"", 1
+    while len(okm) < length:
+        block = hmac.new(prk, block + info + bytes([ctr]),
+                         hashlib.sha256).digest()
+        okm += block
+        ctr += 1
+    return okm[:length]
+
+
+class _Aead:
+    """ChaCha20-Poly1305 with the `cryptography` encrypt/decrypt shape;
+    decrypt raises AuthError on tag mismatch in both backends."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+        self._aead = ChaCha20Poly1305(key) if _HAVE_CRYPTOGRAPHY else None
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if self._aead is not None:
+            return self._aead.encrypt(nonce, data, aad)
+        return chacha20poly1305_seal(self._key, nonce, data, aad or b"")
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if self._aead is not None:
+            try:
+                return self._aead.decrypt(nonce, data, aad)
+            except Exception as e:  # cryptography raises InvalidTag
+                raise AuthError("frame authentication failed") from e
+        pt = chacha20poly1305_open(self._key, nonce, data, aad or b"")
+        if pt is None:
+            raise AuthError("frame authentication failed")
+        return pt
 
 
 def _read_exact(sock, n: int) -> bytes:
@@ -76,28 +146,26 @@ class SecretConnection:
         self._recv_lock = threading.Lock()
         self._recv_buf = b""
 
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        eph_priv, eph_pub = _x25519_keypair()
         sock.sendall(eph_pub)
         their_eph = _read_exact(sock, 32)
 
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        shared = _x25519_exchange(eph_priv, their_eph)
         lo, hi = sorted([eph_pub, their_eph])
         we_are_lo = eph_pub == lo
-        okm = HKDF(
-            algorithm=hashes.SHA256(),
-            length=96,
-            salt=None,
-            info=b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN",
-        ).derive(shared + lo + hi)
+        okm = _hkdf_sha256(
+            shared + lo + hi,
+            96,
+            b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN",
+        )
         key1, key2, challenge = okm[:32], okm[32:64], okm[64:]
         # lo's receive key is key1 (mirrors the reference's assignment)
         if we_are_lo:
-            self._recv_aead = ChaCha20Poly1305(key1)
-            self._send_aead = ChaCha20Poly1305(key2)
+            self._recv_aead = _Aead(key1)
+            self._send_aead = _Aead(key2)
         else:
-            self._recv_aead = ChaCha20Poly1305(key2)
-            self._send_aead = ChaCha20Poly1305(key1)
+            self._recv_aead = _Aead(key2)
+            self._send_aead = _Aead(key1)
         self._send_nonce = _HalfNonce()
         self._recv_nonce = _HalfNonce()
 

@@ -376,35 +376,50 @@ def dump_consensus_state(env, params):
     rpc/core/consensus.go:56 DumpConsensusState). The concise summary
     lives at consensus_state; this one carries the vote bitmaps and the
     reactor's per-peer (height, round, step) view for operators
-    debugging a stall."""
+    debugging a stall.
+
+    Consistency: the consensus thread mutates state concurrently, so a
+    naive field-by-field read can mix heights (e.g. height N's round
+    with height N+1's locked block). Each attempt samples (height,
+    round) before and after gathering and retries on movement; after a
+    few tries the last snapshot is returned as-is — the endpoint is
+    documented best-effort, matching an operator's needs during a stall
+    (when state is static) without blocking consensus to serve RPC."""
     cs = env.consensus
-    votes = []
-    # snapshot under the GIL: the consensus thread inserts rounds into
-    # _sets concurrently (height_vote_set.py _ensure_round) and a live
-    # dict iteration would intermittently raise; dict.copy() is atomic
-    # and prevotes/precommits are .get()-safe for rounds added after
-    hvs = cs.votes
-    for r in sorted(hvs._sets.copy()):
-        votes.append({
-            "round": r,
-            "prevotes": _vote_set_json(hvs.prevotes(r)),
-            "precommits": _vote_set_json(hvs.precommits(r)),
-        })
-    rs = {
-        "height": str(cs.height),
-        "round": cs.round,
-        "step": int(cs.step),
-        "locked_round": cs.locked_round,
-        "locked_block_hash": _hx(
-            cs.locked_block.hash() if getattr(cs, "locked_block", None) else b""
-        ),
-        "valid_round": cs.valid_round,
-        "valid_block_hash": _hx(
-            cs.valid_block.hash() if getattr(cs, "valid_block", None) else b""
-        ),
-        "proposal": cs.proposal is not None,
-        "height_vote_set": votes,
-    }
+    for _attempt in range(3):
+        h0, r0 = cs.height, cs.round
+        votes = []
+        # snapshot under the GIL: the consensus thread inserts rounds
+        # into _sets concurrently (height_vote_set.py _ensure_round) and
+        # a live dict iteration would intermittently raise; dict.copy()
+        # is atomic and prevotes/precommits are .get()-safe for rounds
+        # added after
+        hvs = cs.votes
+        for r in sorted(hvs._sets.copy()):
+            votes.append({
+                "round": r,
+                "prevotes": _vote_set_json(hvs.prevotes(r)),
+                "precommits": _vote_set_json(hvs.precommits(r)),
+            })
+        rs = {
+            "height": str(h0),
+            "round": r0,
+            "step": int(cs.step),
+            "locked_round": cs.locked_round,
+            "locked_block_hash": _hx(
+                cs.locked_block.hash()
+                if getattr(cs, "locked_block", None) else b""
+            ),
+            "valid_round": cs.valid_round,
+            "valid_block_hash": _hx(
+                cs.valid_block.hash()
+                if getattr(cs, "valid_block", None) else b""
+            ),
+            "proposal": cs.proposal is not None,
+            "height_vote_set": votes,
+        }
+        if (cs.height, cs.round) == (h0, r0):
+            break  # nothing moved while we gathered
     peers = []
     reactor = env.consensus_reactor
     if reactor is not None:

@@ -153,16 +153,35 @@ class GenesisDoc:
                 ValidatorParams,
             )
 
+            def mk(param_cls, sd):
+                # forward compatibility (same shape as Config.from_toml's
+                # tolerant loader): a genesis written by a NEWER build may
+                # carry param keys this build does not know — drop them
+                # with a warning instead of raising TypeError at boot
+                from dataclasses import fields as _fields
+
+                known = {f.name for f in _fields(param_cls)}
+                unknown = [k for k in sd if k not in known]
+                if unknown:
+                    from ..utils.log import logger
+
+                    logger("genesis").warn(
+                        "ignoring unknown consensus-param keys",
+                        section=param_cls.__name__,
+                        keys=",".join(sorted(unknown)),
+                    )
+                return param_cls(**{k: v for k, v in sd.items() if k in known})
+
             p = d["consensus_params"]
             bp, ep = p.get("block", {}), p.get("evidence", {})
             vp, ap = p.get("validator", {}), p.get("abci", {})
             gd.consensus_params = ConsensusParams(
-                block=BlockParams(**bp) if bp else BlockParams(),
-                evidence=EvidenceParams(**ep) if ep else EvidenceParams(),
+                block=mk(BlockParams, bp),
+                evidence=mk(EvidenceParams, ep),
                 validator=ValidatorParams(
                     pub_key_types=tuple(vp["pub_key_types"])
-                ) if vp else ValidatorParams(),
-                abci=ABCIParams(**ap) if ap else ABCIParams(),
+                ) if vp.get("pub_key_types") else ValidatorParams(),
+                abci=mk(ABCIParams, ap),
             )
         gd.validate_basic()
         return gd

@@ -131,3 +131,107 @@ def test_e2e_random_manifest_with_partition(tmp_path):
         n2.stop()
     lat = r.latency_report()
     assert lat["count"] > 0 and lat["p50_s"] > 0
+
+
+def test_e2e_seed_only_bootstrap(tmp_path):
+    """Seed-only discovery: 3 validators with NO persistent peers and
+    one seed-mode node. The net must assemble itself purely through PEX
+    (dial seed -> harvest addrs -> dial each other) and converge; the
+    seed crawls-and-disconnects (peer count keeps returning to zero);
+    a restarted validator's address book survives with its old/new
+    split intact (reference test/e2e seed topologies +
+    pex_reactor.go seedMode)."""
+    import threading
+
+    m = Manifest.parse({
+        "chain_id": "e2e-seed",
+        "nodes": [
+            {"name": "node0"}, {"name": "node1"}, {"name": "node2"},
+            {"name": "node3", "seed": True},  # seeds come last
+        ],
+        "perturbations": [
+            # restart one validator mid-run: its persisted book (not
+            # the seed) must carry it back into the net
+            {"node": "node1", "op": "restart", "at_height": 4},
+        ],
+        "target_height": 6,
+        "tx_rate": 5.0,
+        "timeout_s": 180.0,
+    })
+    r = Runner(m, str(tmp_path))
+    r.setup()
+
+    # generated topology: validators have seeds but no persistent peers
+    from cometbft_tpu.config import Config
+    import os
+    for i in range(3):
+        cfg = Config.load(
+            os.path.join(str(tmp_path), f"node{i}", "config", "config.toml")
+        )
+        assert cfg.p2p.persistent_peers == ""
+        assert cfg.p2p.seeds != ""
+        assert not cfg.p2p.seed_mode
+    seed_cfg = Config.load(
+        os.path.join(str(tmp_path), "node3", "config", "config.toml")
+    )
+    assert seed_cfg.p2p.seed_mode
+
+    samples = {}
+
+    def sample_seed():
+        time.sleep(3.0)  # past bootstrap, while the chain is committing
+        samples["counts"] = r.sample_peer_counts(
+            "node3", samples=10, interval_s=0.5
+        )
+
+    t = threading.Thread(target=sample_seed, daemon=True)
+    t.start()
+    r.run()
+    t.join(timeout=10)
+
+    report = r.check_invariants()
+    assert max(report["heights"].values()) >= m.target_height
+    # every VALIDATOR converged (the seed holds no chain)
+    for name in ("node0", "node1", "node2"):
+        assert report["heights"][name] >= 3, report["heights"]
+
+    # the seed never held persistent full-peer connections: its peer
+    # count, sampled over 5s of steady state, kept returning to zero
+    counts = samples.get("counts", [])
+    assert counts, "seed sampling never ran"
+    assert 0 in counts, f"seed held peers continuously: {counts}"
+
+    # address books persisted with the old/new split intact: the
+    # restarted validator saved on shutdown and reloaded on boot, and
+    # proven-good entries (successful outbound dials) are in OLD buckets
+    doc = r.addrbook_doc("node1")
+    assert doc.get("addrs"), "restarted validator persisted no book"
+    assert any(e["is_old"] for e in doc["addrs"]), (
+        "no promoted (old) entries survived the restart"
+    )
+    assert all(0 <= e["bucket"] for e in doc["addrs"])
+    # and the seed's own crawl book knows every validator
+    seed_doc = r.addrbook_doc("node3")
+    assert len(seed_doc.get("addrs", [])) >= 3
+
+
+def test_manifest_generator_draws_seed_topologies():
+    """The generator must (a) emit seed topologies for some seeds, (b)
+    always place seed specs last, never perturb them, and never give
+    them voting power at genesis-relevant positions."""
+    from cometbft_tpu.e2e.manifest import generate_manifest
+
+    seen_seed = False
+    for s in range(40):
+        m = generate_manifest(seed=s, target_height=6)
+        seeds = [n for n in m.nodes if n.seed]
+        if not seeds:
+            continue
+        seen_seed = True
+        assert len(seeds) == 1
+        assert m.nodes[-1].seed, "seed spec must come last"
+        assert not m.nodes[-1].start_at and not m.nodes[-1].state_sync
+        seed_name = m.nodes[-1].name
+        assert all(p.node != seed_name for p in m.perturbations)
+        assert len(m.nodes) >= 4  # >= 3 validators + the seed
+    assert seen_seed, "40 seeds never drew a seed topology (p=0.3 draw)"

@@ -102,8 +102,50 @@ def test_fuzz_pex_decoder():
     seeds = [
         encode_pex_request(),
         encode_pex_addrs([NetAddress("aa" * 20, "127.0.0.1", 26656)]),
+        # richer shapes: empty list, empty-field addr, IPv6 + port
+        # edges, and a full MAX_ADDRS_PER_MSG-sized message
+        encode_pex_addrs([]),
+        encode_pex_addrs([NetAddress("", "", 0)]),
+        encode_pex_addrs([
+            NetAddress("bb" * 20, "::1", 1),
+            NetAddress("cc" * 20, "2001:db8::42", 65535),
+            NetAddress("dd" * 20, "seed.example.com", 26656),
+        ]),
+        encode_pex_addrs([
+            NetAddress(f"{i:040x}", f"10.0.{i // 256}.{i % 256}", 26656)
+            for i in range(100)
+        ]),
     ]
     _fuzz(decode_pex_message, seeds)
+
+
+def test_pex_decoder_nested_garbage():
+    """Hand-crafted malformations beyond random mutation: nested
+    length-prefix lies, wrong wire types, and huge varint ports must be
+    rejected or decoded — never hang or corrupt (the decoder fronts
+    channel 0x00, reachable pre-authorization by any dialer)."""
+    from cometbft_tpu.encoding import proto as pb
+
+    cases = [
+        pb.f_embedded(2, pb.f_embedded(1, b"\xff" * 40)),  # garbage addr
+        pb.f_embedded(2, pb.f_embedded(1, pb.f_embedded(1, pb.f_embedded(
+            1, b"\x08\x01")))),  # over-nesting
+        pb.f_embedded(2, pb.f_varint(1, 7)),  # addr as varint, not bytes
+        pb.f_varint(1, 1 << 62),  # request field with a huge varint
+        pb.f_embedded(2, pb.f_embedded(
+            1, pb.f_string(1, "id") + pb.f_varint(3, 1 << 63))),  # port
+        pb.f_embedded(1, b"") + pb.f_embedded(2, b""),  # both oneof arms
+        b"\xff" * 10,  # bare continuation bits
+    ]
+    for raw in cases:
+        try:
+            kind, addrs = decode_pex_message(raw)
+        except Exception:  # noqa: BLE001 — clean rejection is fine
+            continue
+        assert kind in (None, "request", "addrs")
+        if kind == "addrs":
+            for a in addrs:
+                assert isinstance(a, NetAddress)
 
 
 def test_fuzz_statesync_decoder():

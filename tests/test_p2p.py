@@ -273,3 +273,186 @@ def test_pex_gossip_and_dial(tmp_path):
         assert any(p.id == t_a.node_info.node_id for p in sw_c.peers())
     finally:
         sw_a.stop(); sw_b.stop(); sw_c.stop()
+
+
+def test_addrbook_restart_roundtrip(tmp_path):
+    """Entries, bucket placement, the old/new split, attempt counters,
+    and bans must all survive save -> load -> save -> load (reference
+    addrbook.go saveToFile/loadFromFile)."""
+    from cometbft_tpu.p2p.pex import AddrBook, NetAddress
+
+    path = str(tmp_path / "book.json")
+    book = AddrBook(path)
+    for i in range(12):
+        assert book.add_address(
+            NetAddress(f"id{i}", f"10.{i}.0.1", 26656), source=f"src{i % 3}"
+        )
+    for i in range(4):  # promote a third of them
+        book.mark_good(f"id{i}")
+    for i in range(4, 9):
+        book.mark_attempt(f"id{i}")
+    book.mark_bad("id11")
+    book.save()
+
+    for _restart in range(2):  # two restarts, not just one round trip
+        book = AddrBook(path)
+        book.save()
+    assert book.counts() == (7, 4)  # id11 removed; 4 old, 7 new
+    for i in range(12):
+        ka, orig_old = book.known(f"id{i}"), i < 4
+        if i == 11:
+            assert ka is None
+            assert not book.add_address(
+                NetAddress("id11", "10.11.0.1", 26656)
+            )  # still banned
+            continue
+        assert ka is not None
+        assert ka.is_old == orig_old
+        assert ka.attempts == (1 if 4 <= i < 9 else 0)
+    # bucket assignment is stable across reloads (same persisted key)
+    fresh = AddrBook(path)
+    for nid, ka in fresh._addrs.items():
+        assert book.known(nid).bucket == ka.bucket
+
+
+def test_addrbook_promotion_eviction_and_demotion():
+    """One (addr-group, src-group) pair maps to ONE new bucket, so 65+
+    same-group adds exercise eviction; mass promotion within one /16
+    overflows its <= 4 old buckets and demotes back to new (reference
+    expireNew / moveToOld displacement)."""
+    from cometbft_tpu.p2p.addrbook import BUCKET_SIZE, AddrBook, NetAddress
+
+    book = AddrBook()
+    # stale entries go first when the bucket is full
+    for i in range(BUCKET_SIZE):
+        assert book.add_address(
+            NetAddress(f"n{i}", f"10.1.{i // 256}.{i % 256}", 1000 + i),
+            source="gossiper",
+        )
+    for i in range(3):  # 3 stale: repeated failures, never a success
+        for _ in range(3):
+            book.mark_attempt(f"n{i}")
+    assert book.size() == BUCKET_SIZE
+    assert book.add_address(
+        NetAddress("overflow0", "10.1.200.200", 2000), source="gossiper"
+    )
+    assert book.size() == BUCKET_SIZE  # someone was evicted...
+    assert not book.has("n0")  # ...and it was the stale entry
+
+    # promotion flips the counts
+    book.mark_good("n10")
+    new_n, old_n = book.counts()
+    assert (new_n, old_n) == (BUCKET_SIZE - 1, 1)
+    assert book.known("n10").is_old
+
+    # old-bucket overflow demotes (never silently drops) entries
+    book2 = AddrBook()
+    total = 280  # > OLD_BUCKETS_PER_GROUP * BUCKET_SIZE = 256
+    for i in range(total):
+        assert book2.add_address(
+            NetAddress(f"v{i}", f"44.44.{i // 256}.{i % 256}", 3000 + i),
+            source=f"s{i % 7}",
+        )
+        book2.mark_good(f"v{i}")
+    new_n, old_n = book2.counts()
+    assert old_n <= 4 * BUCKET_SIZE
+    assert new_n + old_n == total  # demoted, not lost
+    assert new_n >= total - 4 * BUCKET_SIZE
+
+
+def test_addrbook_biased_selection_distribution():
+    """pick_address draws from the old group ~70% of the time when both
+    groups are populated (reference PickAddress newBias)."""
+    from cometbft_tpu.p2p.pex import AddrBook, NetAddress
+
+    book = AddrBook()
+    old_ids = set()
+    for i in range(10):
+        book.add_address(
+            NetAddress(f"old{i}", f"20.{i}.0.1", 26656), source="a"
+        )
+        book.mark_good(f"old{i}")
+        old_ids.add(f"old{i}")
+    for i in range(30):
+        book.add_address(
+            NetAddress(f"new{i}", f"30.{i}.0.1", 26656), source="b"
+        )
+    n = 600
+    hits_old = sum(
+        1 for _ in range(n) if book.pick_address().node_id in old_ids
+    )
+    # binomial(600, 0.7): sigma ~ 11, so (0.55, 0.85) is ~8 sigma wide
+    assert 0.55 < hits_old / n < 0.85, f"old fraction {hits_old / n}"
+    # the bias knob is respected at the extremes
+    assert all(
+        book.pick_address(bias_old_pct=100).node_id in old_ids
+        for _ in range(50)
+    )
+    assert all(
+        book.pick_address(bias_old_pct=0).node_id not in old_ids
+        for _ in range(50)
+    )
+
+
+def test_pex_seed_crawler_serves_and_hangs_up(tmp_path):
+    """Seed-mode reactor: an inbound peer gets an addrs reply, then the
+    seed hangs up (sweep past the deadline); a later dialer learns the
+    first peer's address through the seed (reference pex_reactor.go
+    seedMode/crawlPeers)."""
+    from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+
+    def make(name, seed_mode=False):
+        nk = NodeKey.generate()
+        info = NodeInfo(node_id=nk.node_id(), network="seed-chain",
+                        moniker=name)
+        tr = Transport(nk, info)
+        sw = Switch(tr)
+        book = AddrBook(str(tmp_path / f"{name}.json"))
+        pex = PexReactor(book, target_outbound=4, seed_mode=seed_mode,
+                         seed_disconnect_s=0.3)
+        pex.set_switch(sw)
+        sw.add_reactor(pex)
+        tr.listen()
+        sw.start()
+        return sw, tr, book, pex
+
+    sw_s, t_s, book_s, pex_s = make("seed", seed_mode=True)
+    sw_a, t_a, book_a, _ = make("a")
+    sw_b, t_b, book_b, _ = make("b")
+    try:
+        host_s, port_s = t_s.node_info.listen_addr.split(":")
+        sw_a.dial_peer(host_s, int(port_s))
+        # the seed learns A's listen addr from the inbound handshake
+        deadline = time.monotonic() + 10
+        while not book_s.has(t_a.node_info.node_id) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert book_s.has(t_a.node_info.node_id)
+        # past the disconnect deadline the sweep must drop the peer:
+        # a seed never holds persistent full-peer connections
+        time.sleep(0.4)
+        pex_s.sweep_hangups()
+        deadline = time.monotonic() + 5
+        while sw_s.peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not sw_s.peers(), "seed kept a full peer"
+
+        # B bootstraps through the seed and learns A
+        sw_b.dial_peer(host_s, int(port_s))
+        deadline = time.monotonic() + 10
+        while not book_b.has(t_a.node_info.node_id) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert book_b.has(t_a.node_info.node_id), "B never learned A"
+
+        # a crawl round dials from the seed's book and harvests; the
+        # connections are transient (hangup deadlines get set)
+        pex_s.crawl()
+        time.sleep(0.4)
+        pex_s.sweep_hangups()
+        deadline = time.monotonic() + 5
+        while sw_s.peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not sw_s.peers(), "crawl connections were not hung up"
+    finally:
+        sw_s.stop(); sw_a.stop(); sw_b.stop()

@@ -4,12 +4,14 @@
 # catches memory errors the .so build would hide).
 set -e
 cd "$(dirname "$0")/.."
-g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -pthread \
+# -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults
+# to gnu++14 and would fail the build outright
+g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -pthread \
     cometbft_tpu/csrc/ed25519_native.cpp cometbft_tpu/csrc/asan_selftest.cpp -o /tmp/ed25519_asan
 /tmp/ed25519_asan
 # second pass with -march=native: on IFMA-capable hosts this compiles
 # and sanitizes the AVX-512 vector engine (cometbft_tpu/csrc/ed25519_ifma.inc) too
-g++ -O1 -g -march=native -fsanitize=address,undefined \
+g++ -std=c++17 -O1 -g -march=native -fsanitize=address,undefined \
     -fno-omit-frame-pointer -pthread \
     cometbft_tpu/csrc/ed25519_native.cpp cometbft_tpu/csrc/asan_selftest.cpp -o /tmp/ed25519_asan_nat
 /tmp/ed25519_asan_nat
