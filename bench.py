@@ -127,6 +127,43 @@ def main():
                 best_cpu = dt if best_cpu is None else min(best_cpu, dt)
             local_cpu = len(sample) / best_cpu
 
+    # North-star ceiling accounting (VERDICT Next #4): the modeled
+    # per-stage floors behind the dispatch, plus what each path could
+    # deliver if its binding stage were the only cost — and the 8-chip
+    # extrapolation where the device term scales but this host's wire
+    # and pack stages are shared and do not.
+    model = _e.dispatch_model(N_SIGS, _e._bucket(N_SIGS))
+
+    def _cap(stages, chips=1):
+        bound = max(stages["wire"], stages["host"], stages["device"] / chips)
+        return round(N_SIGS / bound, 1)
+
+    ceiling = {
+        "link_mbps": round(model["link_mbps"], 1),
+        "device_us_per_sig": {
+            "ladder": _e._DEV_LADDER_US, "rlc": _e._DEV_RLC_US,
+        },
+        "host_us_per_sig": {
+            "ladder": round(model["host_terms"]["ladder_us"], 3),
+            "rlc": round(model["host_terms"]["rlc_us"], 3),
+            "rlc_threads": model["host_terms"]["rlc_threads"],
+            "calibrated": model["host_terms"]["calibrated"],
+        },
+        "wire_bytes_per_lane": {
+            "ladder": _e._WIRE_LADDER_B, "rlc": _e._WIRE_RLC_B,
+        },
+        "sigs_per_sec_cap": {
+            "ladder": _cap(model["ladder"]),
+            "rlc": _cap(model["rlc"]),
+            "selected": "rlc" if model["t_rlc"] < model["t_ladder"]
+            else "ladder",
+        },
+        "sigs_per_sec_cap_8chip": {
+            "ladder": _cap(model["ladder"], chips=8),
+            "rlc": _cap(model["rlc"], chips=8),
+        },
+    }
+
     print(
         json.dumps(
             {
@@ -149,6 +186,7 @@ def main():
                     if local_cpu else None
                 ),
                 "local_cpu_engine": _native.engine(),
+                "ceiling": ceiling,
             }
         )
     )

@@ -49,14 +49,22 @@ BUCKETS = (64, 256, 1024, 4096, 10240, 16384, 65536)
 # RLC *device* time is 2.11 us/sig — 2x BETTER than the ladder — and
 # the loss is entirely the HOST prepare stage (signed digits + bucket
 # layout, ~20 us/sig of numpy on this 1-core box). The dispatch model
-# therefore carries host, device, and wire terms per path; RLC wins
-# only where the host packer is not the binding stage (multi-core
-# hosts or a future native packer).
+# therefore carries host, device, and wire terms per path; since this
+# PR the RLC host term is the NATIVE packer (csrc/rlc_packer.inc,
+# measured 1.06 us/sig single-worker on a 10k batch — 19x the numpy
+# path), so RLC wins wherever wire isn't the binding stage.
 RLC_MIN = 4096
 _DEV_LADDER_US = 2.39  # measured device-resident pipelined (r5, PROFILE.md)
 _DEV_RLC_US = 2.11     # measured xprof device total (r5, PROFILE.md)
-_HOST_RLC_US = 20.0    # rlc.prepare per sig, 1 numpy core (r5 measured)
-_HOST_LADDER_US = 1.6  # ladder submit packing per sig (r4: ~15-22 ms/10k)
+# Host-side per-sig terms are CALIBRATED at first dispatch decision
+# (_host_terms: one small timed prepare / pack per engine) because they
+# move with the host — core count, toolchain presence, numpy build.
+# These constants are the documented fallbacks when calibration is
+# skipped (COMETBFT_TPU_DISPATCH_CALIBRATE=0) or fails:
+_HOST_RLC_US_NUMPY = 20.0    # numpy rlc.prepare, 1 core (r5 measured)
+_HOST_RLC_US_NATIVE = 1.1    # native packer, ONE worker (r6 measured);
+#                              scaled by rlc_packer_threads() at use
+_HOST_LADDER_US = 1.6        # ladder submit packing (r4: ~15-22 ms/10k)
 _WIRE_LADDER_B = 96    # R||S||k per lane (73 on the delta fast path)
 # R (32) + A (32, re-shipped each submit: the RLC path keys its random
 # layout per batch, so there is no device-resident A cache analogue) +
@@ -92,15 +100,114 @@ def _link_mbps() -> float:
     return _LINK_MBPS
 
 
+_HOST_TERMS: dict | None = None
+
+
+def _calibrate_host_terms() -> dict:
+    """Measure the per-sig host cost of each engine's pack stage on THIS
+    host: one small timed rlc.prepare (native packer when present, numpy
+    otherwise) and one timed pack_rsk for the ladder. Returns fallback
+    constants when calibration is disabled or anything goes wrong —
+    dispatch must keep picking sanely on a box where the probe can't
+    run."""
+    import os as _os
+
+    from . import native
+    from . import rlc as _rlc
+
+    threads = native.rlc_packer_threads()
+    rlc_native = native.rlc_available()
+    terms = {
+        "ladder_us": _HOST_LADDER_US,
+        "rlc_us": (_HOST_RLC_US_NATIVE / threads) if rlc_native
+        else _HOST_RLC_US_NUMPY,
+        "rlc_threads": threads,
+        "rlc_native": rlc_native,
+        "calibrated": False,
+    }
+    if _os.environ.get("COMETBFT_TPU_DISPATCH_CALIBRATE", "1") == "0":
+        return terms
+    try:
+        import time
+
+        n = 1024
+        rnd = np.random.default_rng(0xD15BA7C4)
+        pub_blob = rnd.integers(0, 256, n * 32, np.uint8).tobytes()
+        sig_blob = rnd.integers(0, 256, n * 64, np.uint8).tobytes()
+        msg_blob = rnd.integers(0, 256, n * 100, np.uint8).tobytes()
+        msg_lens = np.full(n, 100, np.uint64)
+        items = [
+            (pub_blob[i * 32:(i + 1) * 32],
+             msg_blob[i * 100:(i + 1) * 100],
+             sig_blob[i * 64:(i + 1) * 64])
+            for i in range(n)
+        ]
+        skip = np.zeros(n, bool)
+        blobs = (pub_blob, sig_blob, msg_blob, msg_lens)
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            prep = _rlc.prepare(items, skip, n, blobs=blobs)
+            best = min(best, time.perf_counter() - t0)
+        if prep is not None:
+            terms["rlc_us"] = best / n * 1e6
+        if native.available():
+            out_rsk = np.empty((n, 96), np.uint8)
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                okp = native.pack_rsk(n, sig_blob, pub_blob, msg_blob,
+                                      msg_lens, out_rsk)
+                best = min(best, time.perf_counter() - t0)
+            if okp:
+                terms["ladder_us"] = best / n * 1e6
+        terms["calibrated"] = True
+    except Exception:
+        return terms
+    return terms
+
+
+def _host_terms() -> dict:
+    """Calibrated host-stage per-sig terms, measured once per process at
+    the first dispatch decision (~a few ms native, ~40 ms numpy-only)."""
+    global _HOST_TERMS
+    if _HOST_TERMS is None:
+        _HOST_TERMS = _calibrate_host_terms()
+    return _HOST_TERMS
+
+
+def dispatch_model(n: int, b: int) -> dict:
+    """The modeled per-stage times (seconds) behind the ladder-vs-RLC
+    dispatch, exposed for bench.py's `ceiling` accounting and the
+    crossover tests: each path's pipelined throughput is bound by the
+    slowest of its host / wire / device stages."""
+    bw = _link_mbps() * 1e6  # bytes/sec
+    host = _host_terms()
+    ladder = {
+        "wire": _WIRE_LADDER_B * b / bw,
+        "device": n * _DEV_LADDER_US * 1e-6,
+        "host": n * host["ladder_us"] * 1e-6,
+    }
+    rlc = {
+        "wire": _WIRE_RLC_B * b / bw,
+        "device": n * _DEV_RLC_US * 1e-6,
+        "host": n * host["rlc_us"] * 1e-6,
+    }
+    return {
+        "link_mbps": _LINK_MBPS,
+        "host_terms": host,
+        "ladder": ladder,
+        "rlc": rlc,
+        "t_ladder": max(ladder.values()),
+        "t_rlc": max(rlc.values()),
+    }
+
+
 def _rlc_beats_ladder(n: int, b: int) -> bool:
     # pipelined throughput is bound by the slowest of the three
     # sequential-resource stages: host packing, wire, device
-    bw = _link_mbps() * 1e6  # bytes/sec
-    t_ladder = max(_WIRE_LADDER_B * b / bw, n * _DEV_LADDER_US * 1e-6,
-                   n * _HOST_LADDER_US * 1e-6)
-    t_rlc = max(_WIRE_RLC_B * b / bw, n * _DEV_RLC_US * 1e-6,
-                n * _HOST_RLC_US * 1e-6)
-    return t_rlc < t_ladder
+    m = dispatch_model(n, b)
+    return m["t_rlc"] < m["t_ladder"]
 
 
 # Below this size the native C++ verifier wins: a commit-sized batch
@@ -391,7 +498,13 @@ class Ed25519BatchVerifier(BatchVerifier):
         n = len(self._items)
         b = _bucket(n)
         skip = np.asarray(self._precheck_fail, bool)
-        prep = _rlc.prepare(self._items, skip, b)
+        # the columnar blobs already exist on this path: hand them to the
+        # native packer so it skips the per-item join (~0.35 us/sig)
+        prep = _rlc.prepare(
+            self._items, skip, b,
+            blobs=(self._pub_buf, self._sig_buf, self._msg_buf,
+                   np.asarray(self._msg_lens, np.uint64)),
+        )
         if prep is None:
             return None
         a_bytes = np.zeros((b, 32), np.uint8)

@@ -29,6 +29,7 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "merkle_native.inc"),
     os.path.join(os.path.dirname(_SRC), "commit_codec.inc"),
     os.path.join(os.path.dirname(_SRC), "sha512_mb.inc"),
+    os.path.join(os.path.dirname(_SRC), "rlc_packer.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -144,6 +145,21 @@ def _bind(lib) -> None:
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint32),
     ]
+    lib.rlc_pack.restype = ctypes.c_long
+    # void_p operands like pack_rsk: the stream/neg/counts/weights
+    # outputs are multi-MB numpy buffers written in place
+    lib.rlc_pack.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,   # n, bucket, depth
+        ctypes.c_void_p, ctypes.c_void_p,                    # pubs, sigs
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),    # msgs, msg_lens
+        ctypes.c_void_p, ctypes.c_void_p,                    # skip, zs
+        ctypes.c_int, ctypes.c_int,                          # elem_size, nchunks
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,   # stream, neg, counts
+        ctypes.c_void_p, ctypes.c_void_p,                    # weights, c
+        ctypes.POINTER(ctypes.c_uint64),                     # s_rounds
+    ]
+    lib.rlc_packer_threads.restype = ctypes.c_int
+    lib.rlc_packer_threads.argtypes = []
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -249,6 +265,57 @@ def pack_rsk(n: int, sig_blob, pub_blob, msg_blob,
         out_rsk.ctypes.data_as(ctypes.c_void_p),
     )
     return True
+
+
+def rlc_available() -> bool:
+    """True when the .so exports the native RLC packer (rlc_pack)."""
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "rlc_pack")
+
+
+def rlc_packer_threads() -> int:
+    """Worker count the native packer spreads a batch across (1 when
+    the lib is absent — the numpy path is single-core anyway). The
+    dispatch model divides its host-prepare term by this."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rlc_packer_threads"):
+        return 1
+    return max(1, int(lib.rlc_packer_threads()))
+
+
+def rlc_pack(n, bucket, depth, pub_blob, sig_blob, msg_blob, msg_lens,
+             skip_u8, z16, elem_size, out_stream, out_neg, out_counts,
+             out_weights, out_c, nchunks=0):
+    """Native crypto/rlc.py prepare: recode + bucket layout + dense
+    stream emission in one C call (multi-threaded, deterministic for
+    any `nchunks`). Blobs may be bytes/bytearray/uint8 arrays (zero
+    copy); msg_lens is a uint64 numpy array; outputs are preallocated
+    C-contiguous numpy arrays (stream >= 39n elems of `elem_size`,
+    neg >= 39n bytes, counts WK bytes, weights (39, 512) int32, c 32
+    bytes). Returns (c_len, s_rounds) — c_len < 0 mirrors the numpy
+    oracle's decline (-1 lane overflow, -2 no live lanes) — or None
+    when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rlc_pack"):
+        return None
+    import numpy as _np
+
+    def _addr(buf):
+        return _np.frombuffer(buf, _np.uint8).ctypes.data_as(ctypes.c_void_p)
+
+    s_rounds = ctypes.c_uint64(0)
+    c_len = lib.rlc_pack(
+        n, bucket, depth, _addr(pub_blob), _addr(sig_blob), _addr(msg_blob),
+        msg_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _addr(skip_u8), _addr(z16), elem_size, nchunks,
+        out_stream.ctypes.data_as(ctypes.c_void_p),
+        out_neg.ctypes.data_as(ctypes.c_void_p),
+        out_counts.ctypes.data_as(ctypes.c_void_p),
+        out_weights.ctypes.data_as(ctypes.c_void_p),
+        out_c.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(s_rounds),
+    )
+    return int(c_len), int(s_rounds.value)
 
 
 def commit_parse(buf: bytes):

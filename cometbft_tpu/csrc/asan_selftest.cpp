@@ -37,6 +37,12 @@ void sha256_oneshot(const u8 *data, u64 len, u8 *out32);
 long commit_parse(const u8 *buf, u64 len, u64 cap, u64 *head, u8 *flags,
                   u8 *addr_lens, u8 *addrs, int64_t *ts_s, int64_t *ts_n,
                   u8 *sig_lens, u8 *sigs, u64 *spans);
+long rlc_pack(u64 n, u64 bucket, u64 depth, const u8 *pubs, const u8 *sigs,
+              const u8 *msgs, const u64 *msg_lens, const u8 *skip,
+              const u8 *zs, int elem_size, int nchunks, u8 *out_stream,
+              u8 *out_neg, u8 *out_counts, int32_t *out_weights, u8 *out_c,
+              u64 *out_s_rounds);
+int rlc_packer_threads(void);
 }
 
 // deterministic PRNG for the fuzz loops (no OS entropy in the harness)
@@ -181,6 +187,113 @@ static int new_surface_checks() {
     return 0;
 }
 
+// crypto/rlc.py slot_depth: ceil(mean + 4*sqrt(mean) + 4), mean =
+// max(bucket/512, 1) — recomputed here so the harness exercises the
+// same (bucket, depth) pairs the Python caller ships
+static u64 slot_depth(u64 bucket) {
+    double mean = bucket > 512 ? (double)bucket / 512.0 : 1.0;
+    double d = mean + 4.0 * __builtin_sqrt(mean) + 4.0;
+    u64 r = (u64)d;
+    return (double)r < d ? r + 1 : r;
+}
+
+// one rlc_pack call with TIGHTLY-sized heap outputs (stream/neg exactly
+// 39n entries) so ASAN catches any overrun of the emission cursors
+static long pack_once(u64 n, u64 bucket, int elem_size, int nchunks,
+                      const u8 *skip_override, std::vector<u8> *snap) {
+    std::vector<u8> pubs(n * 32), sigs(n * 64), msgs, skip(n, 0), zs(n * 16);
+    std::vector<u64> lens(n);
+    for (u64 i = 0; i < n; i++) {
+        for (int b = 0; b < 32; b++) pubs[i * 32 + b] = lcg();
+        for (int b = 0; b < 64; b++) sigs[i * 64 + b] = lcg();
+        for (int b = 0; b < 16; b++) zs[i * 16 + b] = lcg();
+        u64 ln = (i % 4) * 33;  // ragged incl. zero-length
+        lens[i] = ln;
+        for (u64 b = 0; b < ln; b++) msgs.push_back(lcg());
+    }
+    if (skip_override) memcpy(skip.data(), skip_override, n);
+    u64 cap = 39 * n;  // exact contribution bound: 13 z + 26 m digits
+    std::vector<u8> stream(cap ? cap * (u64)elem_size : 1);
+    std::vector<u8> neg(cap ? cap : 1), counts(39 * 512);
+    std::vector<int32_t> weights(39 * 512);
+    u8 c_out[32];
+    u64 s_rounds = 0;
+    long rc = rlc_pack(n, bucket, slot_depth(bucket), pubs.data(),
+                       sigs.data(), msgs.data(), lens.data(), skip.data(),
+                       zs.data(), elem_size, nchunks, stream.data(),
+                       neg.data(), counts.data(), weights.data(), c_out,
+                       &s_rounds);
+    if (snap && rc >= 0) {
+        snap->assign(stream.begin(), stream.begin() + (size_t)rc * elem_size);
+        snap->insert(snap->end(), neg.begin(), neg.begin() + rc);
+        snap->insert(snap->end(), counts.begin(), counts.end());
+        const u8 *w = (const u8 *)weights.data();
+        snap->insert(snap->end(), w, w + 39 * 512 * 4);
+        snap->insert(snap->end(), c_out, c_out + 32);
+        snap->push_back((u8)s_rounds);
+    }
+    return rc;
+}
+
+static int rlc_packer_checks() {
+    if (rlc_packer_threads() < 1) {
+        printf("FAIL: rlc_packer_threads < 1\n");
+        return 1;
+    }
+    // n == 0 and all-skip: decline (-2), outputs untouched beyond zeroing
+    u64 dummy = 0;
+    u8 c_out[32];
+    std::vector<u8> counts0(39 * 512);
+    std::vector<int32_t> weights0(39 * 512);
+    if (rlc_pack(0, 64, slot_depth(64), nullptr, nullptr, nullptr, nullptr,
+                 nullptr, nullptr, 2, 0, nullptr, nullptr, counts0.data(),
+                 weights0.data(), c_out, &dummy) != -2) {
+        printf("FAIL: rlc_pack(n=0) != -2\n");
+        return 1;
+    }
+    std::vector<u8> all_skip(40, 1);
+    if (pack_once(40, 64, 2, 0, all_skip.data(), nullptr) != -2) {
+        printf("FAIL: rlc_pack(all-skip) != -2\n");
+        return 1;
+    }
+    // depth guard (-3: bucket beyond the uint8 counts bound) and the
+    // uint16/bucket mismatch guard
+    if (rlc_pack(1, 1 << 20, 300, nullptr, nullptr, nullptr, nullptr,
+                 nullptr, nullptr, 4, 0, nullptr, nullptr, counts0.data(),
+                 weights0.data(), c_out, &dummy) != -3 ||
+        pack_once(4, 65536, 2, 0, nullptr, nullptr) != -3) {
+        printf("FAIL: rlc_pack guard rcs\n");
+        return 1;
+    }
+    // normal mixed-length batch with a partial skip mask, both widths
+    std::vector<u8> some_skip(64, 0);
+    for (int i = 0; i < 64; i += 5) some_skip[i] = 1;
+    if (pack_once(64, 64, 2, 0, some_skip.data(), nullptr) <= 0 ||
+        pack_once(64, 10240, 4, 0, some_skip.data(), nullptr) <= 0) {
+        printf("FAIL: rlc_pack normal batches\n");
+        return 1;
+    }
+    // max-bucket shape: 65536 needs uint32 stream and depth 178 <= 255
+    if (pack_once(48, 65536, 4, 0, nullptr, nullptr) <= 0) {
+        printf("FAIL: rlc_pack max bucket\n");
+        return 1;
+    }
+    // determinism contract: chunked runs must be byte-identical (the
+    // lcg is reseeded so both calls generate the same batch)
+    u64 seed_snapshot = lcg_state;
+    std::vector<u8> one, three;
+    long r1 = pack_once(96, 1024, 2, 1, nullptr, &one);
+    lcg_state = seed_snapshot;
+    long r3 = pack_once(96, 1024, 2, 3, nullptr, &three);
+    if (r1 <= 0 || r1 != r3 || one != three) {
+        printf("FAIL: rlc_pack not chunk-count deterministic\n");
+        return 1;
+    }
+    printf("asan rlc packer checks ok (guards, skip masks, max bucket, "
+           "chunk determinism)\n");
+    return 0;
+}
+
 int main() {
     const int N = 96;
     std::vector<u8> pubs(N * 32), sigs(N * 64), msgs;
@@ -222,6 +335,7 @@ int main() {
         return 1;
     }
     if (new_surface_checks() != 0) return 1;
+    if (rlc_packer_checks() != 0) return 1;
     printf("asan selftest ok (%d signatures, threaded batch)\n", N);
     return 0;
 }

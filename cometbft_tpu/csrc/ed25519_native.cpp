@@ -1331,6 +1331,10 @@ void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
 
 }  // extern "C"
 
+// RLC/MSM host packer — native port of crypto/rlc.py prepare
+// (own extern "C" exports: rlc_pack, rlc_packer_threads)
+#include "rlc_packer.inc"
+
 // SHA-256 + RFC-6962 merkle root engine (own extern "C" exports)
 #include "merkle_native.inc"
 

@@ -1,0 +1,189 @@
+"""Native RLC packer (csrc/rlc_packer.inc) vs the numpy rlc.prepare
+oracle: with the z coefficients pinned, the two engines must produce
+byte-identical device inputs — stream, signs, counts, weights, c — for
+every batch shape, bucket size, and skip mask. The packer is also
+checked for chunk-count independence (the determinism contract the
+worker pool must honor)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import native, rlc
+
+pytestmark = pytest.mark.skipif(
+    not native.rlc_available(), reason="no native RLC packer"
+)
+
+rng = np.random.default_rng(11)
+
+L = rlc.L
+
+_KEYS = ("stream", "stream_neg", "counts", "weights", "c_digits")
+
+
+def _items(n, msg_len=None):
+    out = []
+    for _ in range(n):
+        ml = int(rng.integers(0, 180)) if msg_len is None else msg_len
+        out.append((rng.bytes(32), rng.bytes(ml), rng.bytes(64)))
+    return out
+
+
+def _z16(n):
+    return rng.integers(0, 256, (n, 16)).astype(np.uint8)
+
+
+def _assert_same(a, b, ctx):
+    assert (a is None) == (b is None), ctx
+    if a is None:
+        return
+    for k in _KEYS:
+        assert np.array_equal(a[k], b[k]), (ctx, k)
+        assert a[k].dtype == b[k].dtype, (ctx, k, a[k].dtype, b[k].dtype)
+    assert a["s_rounds"] == b["s_rounds"], ctx
+
+
+def _diff(items, skip, bucket, z16):
+    a = rlc._prepare_native(items, skip, bucket, z16, None)
+    assert a is not rlc._NATIVE_MISS
+    b = rlc.prepare_numpy(items, skip, bucket, z16)
+    _assert_same(a, b, (len(items), bucket))
+    return a
+
+
+def test_differential_every_bucket():
+    # all production tiers incl. the commit-shaped 10240 and the uint32
+    # stream at 16384/65536 (sentinel 2*bucket > 0x7fff)
+    from cometbft_tpu.crypto.ed25519 import BUCKETS
+
+    for bucket in BUCKETS:
+        n = min(bucket, 96)
+        prep = _diff(_items(n), np.zeros(n, bool), bucket, _z16(n))
+        want = np.uint32 if 2 * bucket > 0x7FFF else np.uint16
+        assert prep["stream"].dtype == want
+
+
+def test_differential_skip_masks():
+    n = 64
+    items, z16 = _items(n), _z16(n)
+    for mask in (
+        np.zeros(n, bool),                      # none skipped
+        rng.integers(0, 2, n).astype(bool),     # random partial
+        np.arange(n) % 2 == 0,                  # alternating
+        np.ones(n, bool),                       # all skipped -> None
+    ):
+        _diff(items, mask, 64, z16)
+
+
+def test_differential_edge_scalars():
+    # s = 0, s = L-1, non-canonical s >= L, and extreme R/z bytes: the
+    # scalar pipeline (muladd mod L, signed-digit recode) must agree
+    # with Python bigints even outside the canonical range
+    edge_s = [
+        (0).to_bytes(32, "little"),
+        (L - 1).to_bytes(32, "little"),
+        L.to_bytes(32, "little"),
+        (2**256 - 1).to_bytes(32, "little"),
+        (L + 12345).to_bytes(32, "little"),
+    ]
+    items = [
+        (rng.bytes(32), rng.bytes(50), rng.bytes(32) + s) for s in edge_s
+    ]
+    items += _items(11)
+    n = len(items)
+    z16 = _z16(n)
+    z16[0] = 0     # forced to 1 by the |1 guard in both engines
+    z16[1] = 0xFF  # max z
+    _diff(items, np.zeros(n, bool), 64, z16)
+
+
+def test_differential_fuzz():
+    for trial in range(10):
+        n = int(rng.integers(1, 160))
+        bucket = int(rng.choice([64, 256, 1024, 10240, 16384]))
+        skip = rng.integers(0, 4, n) == 0
+        _diff(_items(n), skip, bucket, _z16(n))
+
+
+def test_empty_and_allskip_decline():
+    assert rlc.prepare([], np.zeros(0, bool), 64) is None
+    items = _items(4)
+    assert rlc.prepare(items, np.ones(4, bool), 64) is None
+
+
+def test_blobs_path_matches_items_path():
+    # the submit path hands preassembled columnar blobs; same output
+    n = 80
+    items, z16 = _items(n), _z16(n)
+    skip = np.zeros(n, bool)
+    blobs = (
+        b"".join(it[0] for it in items),
+        b"".join(it[2] for it in items),
+        b"".join(it[1] for it in items),
+        np.array([len(it[1]) for it in items], np.uint64),
+    )
+    a = rlc._prepare_native(items, skip, 256, z16, blobs)
+    b = rlc._prepare_native(items, skip, 256, z16, None)
+    _assert_same(a, b, "blobs")
+
+
+def test_chunk_count_determinism():
+    # the worker-pool contract: output is byte-identical for ANY chunk
+    # count (per-chunk histograms merge into exclusive cursors in chunk
+    # order, so parallel emission lands every entry at the same offset)
+    n, bucket = 200, 1024
+    depth = rlc.slot_depth(bucket)
+    items, z16 = _items(n), _z16(n)
+    skip = (np.arange(n) % 9 == 0).astype(np.uint8)
+    blobs = dict(
+        pub=b"".join(it[0] for it in items),
+        sig=b"".join(it[2] for it in items),
+        msg=b"".join(it[1] for it in items),
+        lens=np.array([len(it[1]) for it in items], np.uint64),
+    )
+    cap = rlc.N_REGIONS * n + 8
+    outs = []
+    for nchunks in (1, 2, 3, 7):
+        stream = np.zeros(cap, np.uint16)
+        neg = np.zeros(cap, np.uint8)
+        counts = np.zeros(rlc.WK, np.uint8)
+        weights = np.zeros((rlc.N_REGIONS, rlc.K_BUCKETS), np.int32)
+        out_c = np.zeros(32, np.uint8)
+        res = native.rlc_pack(
+            n, bucket, depth, blobs["pub"], blobs["sig"], blobs["msg"],
+            blobs["lens"], skip, z16, 2, stream, neg, counts, weights,
+            out_c, nchunks=nchunks,
+        )
+        assert res is not None
+        c_len, s_rounds = res
+        assert c_len > 0
+        outs.append((c_len, s_rounds, stream.tobytes(), neg.tobytes(),
+                     counts.tobytes(), weights.tobytes(), out_c.tobytes()))
+    for o in outs[1:]:
+        assert o == outs[0]
+
+
+def test_uniform_lengths_hit_mb_grouping():
+    # uniform message lengths drive the 8-way MB-SHA512 group path on
+    # AVX-512 hosts and the scalar path elsewhere; either way the
+    # challenge scalars must match the oracle's hashlib
+    n = 40
+    items, z16 = _items(n, msg_len=100), _z16(n)
+    _diff(items, np.zeros(n, bool), 64, z16)
+
+
+def test_prepare_routes_native():
+    # prepare() without pinned z must take the native path: pin the
+    # numpy oracle to a poisoned stub and check prepare still succeeds
+    n = 32
+    items = _items(n)
+    sentinel = {}
+
+    orig = rlc.prepare_numpy
+    rlc.prepare_numpy = lambda *a, **k: sentinel
+    try:
+        out = rlc.prepare(items, np.zeros(n, bool), 64)
+    finally:
+        rlc.prepare_numpy = orig
+    assert out is not sentinel and out is not None
+    assert out["counts"].sum() > 0

@@ -1,7 +1,9 @@
 #!/bin/sh
 # ASAN/UBSAN build + run of the native Ed25519 engine (SURVEY §5.2's
 # sanitizer leg for csrc; the Python suite covers the logic, this
-# catches memory errors the .so build would hide).
+# catches memory errors the .so build would hide). Covers the RLC
+# packer entry points (rlc_pack / rlc_packer_threads) with tight
+# buffers: n==0, all-skip, max-bucket, and chunk-determinism shapes.
 set -e
 cd "$(dirname "$0")/.."
 # -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults
