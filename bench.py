@@ -164,6 +164,29 @@ def main():
         },
     }
 
+    # snapshot of the run's crypto instrumentation: which dispatch paths
+    # fired, the observed batch-size distribution, and per-path verify
+    # latency — the same series a live node exports on /metrics
+    from cometbft_tpu.utils.metrics import crypto_metrics
+
+    cm = crypto_metrics()
+    metrics_snapshot = {
+        "path_selected_total": {
+            (k[0] if k else ""): v
+            for k, v in cm.path_selected_total.values().items()
+        },
+        "batch_size": {
+            (",".join(k) if k else ""): v
+            for k, v in cm.batch_size.snapshot().items()
+        },
+        "verify_seconds": {
+            (k[0] if k else ""): {
+                "count": v["count"], "sum_s": round(v["sum"], 4)
+            }
+            for k, v in cm.verify_seconds.snapshot().items()
+        },
+    }
+
     print(
         json.dumps(
             {
@@ -187,6 +210,7 @@ def main():
                 ),
                 "local_cpu_engine": _native.engine(),
                 "ceiling": ceiling,
+                "crypto_metrics": metrics_snapshot,
             }
         )
     )

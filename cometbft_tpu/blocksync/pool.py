@@ -14,6 +14,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils.metrics import blocksync_metrics
+
 REQUEST_WINDOW = 64       # in-flight heights (reference maxPendingRequests)
 RETRY_SECONDS = 5.0       # per-height fetch timeout before trying a new peer
 
@@ -43,11 +45,17 @@ class BlockPool:
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
         with self._lock:
             self._peers[peer_id] = (base, height)
+            m = blocksync_metrics()
+            m.peer_height.set(height, peer_id)
+            m.num_peers.set(len(self._peers))
             self._lock.notify_all()
 
     def remove_peer(self, peer_id: str) -> None:
         with self._lock:
-            self._peers.pop(peer_id, None)
+            if self._peers.pop(peer_id, None) is not None:
+                m = blocksync_metrics()
+                m.peer_height.remove(peer_id)
+                m.num_peers.set(len(self._peers))
             for r in self._requesters.values():
                 if r.peer_id == peer_id and r.block is None:
                     r.peer_id = None  # refetch from someone else
@@ -85,6 +93,9 @@ class BlockPool:
                 r.peer_id = peer
                 r.sent_at = now
                 sends.append((peer, r.height))
+            blocksync_metrics().pending_requests.set(
+                sum(1 for r in self._requesters.values() if r.block is None)
+            )
         for peer, h in sends:
             self._send(peer, h)
 

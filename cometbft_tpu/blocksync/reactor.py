@@ -19,7 +19,9 @@ from ..p2p.switch import Reactor
 from ..types import Block
 from ..types.block import block_id_for
 from ..types.validation import CommitError, verify_commit_light
+from ..utils import trace
 from ..utils.log import logger
+from ..utils.metrics import blocksync_metrics
 from .pool import BlockPool
 
 BLOCKSYNC_CHANNEL = 0x40
@@ -137,6 +139,8 @@ class BlockSyncReactor(Reactor):
         start = _time.monotonic()
         deadline = start + timeout_s
         applied = 0
+        m = blocksync_metrics()
+        m.syncing.set(1)
         while _time.monotonic() < deadline:
             self.pool.make_requests()
             first, second = self.pool.peek_two_blocks()
@@ -150,6 +154,7 @@ class BlockSyncReactor(Reactor):
                 self.pool.wait_for_blocks(poll_s)
                 continue
             bid = block_id_for(first)
+            t_fetch = _time.perf_counter()
             try:
                 # block H is endorsed by H+1's LastCommit — the batch
                 # verify hot path (reference reactor.go:462)
@@ -163,13 +168,27 @@ class BlockSyncReactor(Reactor):
                 )
             except CommitError as e:
                 bad = self.pool.redo_request(first.header.height)
+                m.bad_blocks_total.inc()
                 _log.warn("invalid block from peer", height=first.header.height,
                           peer=(bad or "?")[:12], err=str(e)[:80])
                 continue
+            t_verify = _time.perf_counter()
             state = self.executor.apply_block(state, bid, first)
             self.store.save_block(first, second.last_commit)
             self.pool.pop_request()
             applied += 1
+            m.blocks_applied_total.inc()
+            m.latest_block_height.set(first.header.height)
+            if trace.enabled:
+                t_apply = _time.perf_counter()
+                trace.emit(
+                    "blocksync.block", "span",
+                    height=first.header.height,
+                    dur_ms=round((t_apply - t_fetch) * 1e3, 3),
+                    verify_ms=round((t_verify - t_fetch) * 1e3, 3),
+                    apply_ms=round((t_apply - t_verify) * 1e3, 3),
+                )
+        m.syncing.set(0)
         self.state = state
         _log.debug("block sync done", applied=applied,
                    height=state.last_block_height)

@@ -217,6 +217,24 @@ class StorageConfig:
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
+    # metric-name prefix (reference instrumentation.namespace)
+    namespace: str = "cometbft"
+    # JSONL span/event sink (utils/trace.py); empty disables tracing.
+    # Relative paths resolve under the node home. The COMETBFT_TPU_TRACE
+    # env var overrides at process level (subprocess nodes, bench.py).
+    trace_sink: str = ""
+
+    def validate(self) -> None:
+        if self.prometheus:
+            addr = self.prometheus_listen_addr
+            _, _, port = addr.rpartition(":")
+            if not port.isdigit():
+                raise ValueError(
+                    "instrumentation.prometheus_listen_addr must end in"
+                    f" :<port>, got {addr!r}"
+                )
+        if not self.namespace:
+            raise ValueError("instrumentation.namespace must be non-empty")
 
 
 @dataclass
@@ -235,7 +253,8 @@ class Config:
 
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
-                        self.consensus, self.blocksync, self.statesync):
+                        self.consensus, self.blocksync, self.statesync,
+                        self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------

@@ -32,6 +32,7 @@ import time as _time
 from dataclasses import dataclass, field as dc_field
 
 from ..state.execution import BlockExecutor, BlockValidationError, validate_block
+from ..utils import trace
 from ..utils.fail import fail_point
 from ..utils.log import logger
 from ..utils.metrics import consensus_metrics
@@ -145,6 +146,7 @@ class ConsensusState:
         self.height = sm_state.last_block_height + 1
         self.round = 0
         self.step = RoundStep.NEW_HEIGHT
+        self._step_t0 = time.perf_counter()
         self.validators: ValidatorSet = sm_state.validators.copy()
         self.proposal: Proposal | None = None
         self.proposal_block: Block | None = None
@@ -512,6 +514,21 @@ class ConsensusState:
     # step functions
     # ==================================================================
     def _update_step(self, round_: int, step: RoundStep) -> None:
+        # Every step transition funnels through here: close the span for
+        # the step being left (tracer + step-duration histogram), then
+        # switch. One perf_counter read per transition when idle.
+        prev = self.step
+        if prev != step:
+            now = time.perf_counter()
+            dur = now - self._step_t0
+            self._step_t0 = now
+            consensus_metrics().step_duration_seconds.observe(dur, prev.name)
+            if trace.enabled:
+                trace.emit(
+                    "consensus.step", "span", step=prev.name,
+                    height=self.height, round=self.round,
+                    dur_ms=round(dur * 1e3, 3), next=step.name,
+                )
         self.round = round_
         self.step = step
 
@@ -730,6 +747,11 @@ class ConsensusState:
             "finalized block", height=h, round=self.commit_round,
             txs=len(block.data.txs), hash=block.hash().hex()[:16],
         )
+        if trace.enabled:
+            trace.event(
+                "consensus.finalize_commit", height=h,
+                round=self.commit_round, txs=len(block.data.txs),
+            )
         m = consensus_metrics()
         m.height.set(h)
         m.validators.set(len(self.validators))

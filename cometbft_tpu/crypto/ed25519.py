@@ -18,8 +18,12 @@ happens on-device inside the batch, where it is amortized across lanes.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
+from ..utils import trace as _trace
+from ..utils.metrics import crypto_metrics
 from . import ed25519_ref as ref
 from .keys import BatchVerifier, PrivKey, PubKey, tmhash20
 
@@ -173,6 +177,12 @@ def _host_terms() -> dict:
     global _HOST_TERMS
     if _HOST_TERMS is None:
         _HOST_TERMS = _calibrate_host_terms()
+        cm = crypto_metrics()
+        for term in ("ladder_us", "rlc_us"):
+            cm.calibration_us_per_sig.set(_HOST_TERMS[term], term)
+        cm.calibration_us_per_sig.set(
+            float(_HOST_TERMS.get("calibrated", False)), "calibrated"
+        )
     return _HOST_TERMS
 
 
@@ -266,6 +276,7 @@ class Ed25519PubKey(PubKey):
         # back to the oracle when no toolchain is available
         from . import native
 
+        crypto_metrics().path_selected_total.inc(1.0, "single")
         if native.available():
             return native.verify(self._b, msg, sig)
         return ref.verify(self._b, msg, sig)
@@ -420,11 +431,21 @@ class Ed25519BatchVerifier(BatchVerifier):
         if not self.count():
             return False, []
         if self.backend == "cpu":
+            t0 = _time.perf_counter()
             self._materialize()
             bits = [
                 (not bad) and ref.verify(p, m, s)
                 for (p, m, s), bad in zip(self._items, self._precheck_fail)
             ]
+            dt = _time.perf_counter() - t0
+            m = crypto_metrics()
+            m.batch_size.observe(self.count())
+            m.path_selected_total.inc(1.0, "cpu")
+            m.verify_seconds.observe(dt, "cpu")
+            if _trace.enabled:
+                _trace.emit("crypto.batch_verify", "span",
+                            dur_ms=round(dt * 1e3, 3), path="cpu",
+                            n=self.count())
             return all(bits), bits
         return self.submit().result()
 
@@ -440,26 +461,60 @@ class Ed25519BatchVerifier(BatchVerifier):
         ours overlaps host packing with device compute instead.
         """
         n = self.count()
+        t0 = _time.perf_counter()
+        pending = None
+        path = "ladder"
         if not self._force_perlane:
             if n < NATIVE_MAX:
                 pending = self._native_batch()
                 if pending is not None:
-                    return pending
-            if n >= RLC_MIN and _rlc_beats_ladder(n, _bucket(n)):
+                    path = "native"
+            if (pending is None and n >= RLC_MIN
+                    and _rlc_beats_ladder(n, _bucket(n))):
                 pending = self._launch_rlc()
                 if pending is not None:
-                    return pending
-        bits, all_ok = self._launch_device()
-        # Snapshot per-batch state: the verifier may be reused/mutated
-        # after submit() without corrupting in-flight results.
-        return PendingBatch(
-            bits,
-            all_ok,
-            n,
-            list(self._precheck_fail),
-            [self._items[i] for i in self._oversize],
-            list(self._oversize),
-        )
+                    path = "rlc"
+        if pending is None:
+            bits, all_ok = self._launch_device()
+            path = self._device_path
+            # Snapshot per-batch state: the verifier may be reused/mutated
+            # after submit() without corrupting in-flight results.
+            pending = PendingBatch(
+                bits,
+                all_ok,
+                n,
+                list(self._precheck_fail),
+                [self._items[i] for i in self._oversize],
+                list(self._oversize),
+            )
+        self._record_dispatch(path, n, t0, pending)
+        return pending
+
+    def _record_dispatch(self, path: str, n: int, t0: float,
+                         pending) -> None:
+        """Crypto-dispatch observability: per-path selection counter,
+        batch-size histogram, and (via the pending handle) the
+        submit→result latency; one trace span per batch with the
+        dispatch_model() stage terms behind the decision."""
+        host_s = _time.perf_counter() - t0
+        m = crypto_metrics()
+        m.batch_size.observe(n)
+        m.path_selected_total.inc(1.0, path)
+        pending._path = path
+        pending._t0 = t0
+        if _trace.enabled:
+            fields = {"path": path, "n": n}
+            if path in ("rlc", "ladder", "delta"):
+                mdl = dispatch_model(n, _bucket(n))
+                stages = mdl["rlc"] if path == "rlc" else mdl["ladder"]
+                fields.update(
+                    model_host_ms=round(stages["host"] * 1e3, 3),
+                    model_wire_ms=round(stages["wire"] * 1e3, 3),
+                    model_device_ms=round(stages["device"] * 1e3, 3),
+                    link_mbps=round(mdl["link_mbps"], 1),
+                )
+            _trace.emit("crypto.batch_verify", "span",
+                        dur_ms=round(host_s * 1e3, 3), **fields)
 
     def _native_batch(self):
         """Synchronous C++ RLC batch for commit-sized batches; None when
@@ -570,8 +625,10 @@ class Ed25519BatchVerifier(BatchVerifier):
             verify_batch_cached_a_jit,
         )
 
+        self._device_path = "ladder"
         if self._device_sha:
             self._materialize()
+            self._device_path = "device_sha"
             return self._launch_device_sha()
 
         n = self.count()
@@ -591,6 +648,7 @@ class Ed25519BatchVerifier(BatchVerifier):
                 self._delta = _detect_delta(self._items) or False
             if self._delta:
                 self._materialize()
+                self._device_path = "delta"
                 return self._launch_device_delta(self._delta)
         pub_blob = self._pub_buf  # zero-copy; hashed + copied below only
         rsk = np.zeros((b, 96), np.uint8)
@@ -768,6 +826,18 @@ class Ed25519BatchVerifier(BatchVerifier):
             *jax.device_put((a_bytes, r_bytes, s_raw, msg_words, two_blocks, live))
         )
 
+def _observe_latency(p) -> None:
+    """Record submit→result wall time into the per-path verify-latency
+    histogram; idempotent (the first resolution wins)."""
+    t0 = getattr(p, "_t0", None)
+    if t0 is None:
+        return
+    p._t0 = None
+    crypto_metrics().verify_seconds.observe(
+        _time.perf_counter() - t0, getattr(p, "_path", None) or "unknown"
+    )
+
+
 def _prefetch_summary(arr) -> None:
     """Start an async device->host copy of a summary scalar (no-op for
     host-resident or stubbed summaries)."""
@@ -787,7 +857,7 @@ class PendingBatch:
     only when some lane failed."""
 
     __slots__ = ("_dev", "_all_ok", "_n", "_precheck_fail",
-                 "_oversize_items", "_oversize_idx")
+                 "_oversize_items", "_oversize_idx", "_path", "_t0")
 
     def __init__(self, dev, all_ok, n, precheck_fail, oversize_items,
                  oversize_idx):
@@ -797,6 +867,8 @@ class PendingBatch:
         self._precheck_fail = precheck_fail
         self._oversize_items = oversize_items
         self._oversize_idx = oversize_idx
+        self._path = None
+        self._t0 = None
 
     def _finalize(self, bits) -> tuple[bool, list[bool]]:
         out = [bool(x) and not bad for x, bad in zip(bits, self._precheck_fail)]
@@ -807,6 +879,7 @@ class PendingBatch:
     def _finalize_fast(self, dev_all_ok: bool) -> tuple[bool, list[bool]]:
         """Resolve from the scalar summary alone when possible; falls back
         to the bitmap transfer on any failure."""
+        _observe_latency(self)
         if dev_all_ok and not any(self._precheck_fail):
             bits = [True] * self._n
             ok = True
@@ -833,20 +906,24 @@ class PendingBatch:
 class DonePending:
     """Already-resolved batch (native CPU path) behind the pending API."""
 
-    __slots__ = ("_ok", "_bits", "_all_ok")
+    __slots__ = ("_ok", "_bits", "_all_ok", "_path", "_t0")
 
     def __init__(self, ok, bits):
         self._ok = ok
         self._bits = bits
         self._all_ok = np.asarray(ok)  # collect_pending stacks this
+        self._path = None
+        self._t0 = None
 
     def _finalize_fast(self, _dev_all_ok) -> tuple[bool, list[bool]]:
+        _observe_latency(self)
         return self._ok, self._bits
 
     def prefetch(self) -> None:
         pass  # already host-resident
 
     def result(self) -> tuple[bool, list[bool]]:
+        _observe_latency(self)
         return self._ok, self._bits
 
 
@@ -856,15 +933,19 @@ class PendingRLC:
     the per-lane bitmap kernel re-runs to attribute blame, mirroring the
     reference's batch->single fallback (types/validation.go:304-311)."""
 
-    __slots__ = ("_all_ok", "_n", "_precheck_fail", "_items")
+    __slots__ = ("_all_ok", "_n", "_precheck_fail", "_items", "_path",
+                 "_t0")
 
     def __init__(self, all_ok, n, precheck_fail, items):
         self._all_ok = all_ok
         self._n = n
         self._precheck_fail = precheck_fail
         self._items = items
+        self._path = None
+        self._t0 = None
 
     def _finalize_fast(self, dev_all_ok: bool) -> tuple[bool, list[bool]]:
+        _observe_latency(self)
         if dev_all_ok:
             bits = [not bad for bad in self._precheck_fail]
             return all(bits), bits

@@ -47,10 +47,11 @@ def _rpc(port: int, method: str, params: dict | None = None, timeout=3.0):
 
 class _ProcNode:
     def __init__(self, name: str, home: str, rpc_port: int,
-                 command: list[str] | None = None):
+                 command: list[str] | None = None, metrics_port: int = 0):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
+        self.metrics_port = metrics_port
         self.proc: subprocess.Popen | None = None
         self.log = open(os.path.join(home, "node.log"), "ab")
         # per-node env overrides applied at (re)start — the "upgrade"
@@ -121,8 +122,10 @@ class Runner:
                  node_commands: dict[str, list[str]] | None = None):
         self.manifest = manifest
         self.workdir = workdir
+        # three ports per node: p2p (+2i), rpc (+2i+1), and a metrics
+        # listener block after the p2p/rpc range (+2N+i)
         self.starting_port = starting_port or self._free_port_base(
-            2 * len(manifest.nodes)
+            3 * len(manifest.nodes)
         )
         # per-node alternate build invocations (mixed-version nets);
         # environment-specific, so a Runner argument rather than a
@@ -206,11 +209,17 @@ class Runner:
             cfg.base.abci_call_log = True
             # every node snapshots so statesync joiners find providers
             cfg.base.snapshot_interval = 2
+            # prometheus endpoint per node so the runner can assert live
+            # series mid-run (reference test/e2e enabling instrumentation)
+            mport = self.starting_port + 2 * len(m.nodes) + i
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = f"127.0.0.1:{mport}"
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
             self.nodes[spec.name] = _ProcNode(
                 spec.name, home, port,
                 command=self.node_commands.get(spec.name),
+                metrics_port=mport,
             )
 
     def _node_id(self, name: str) -> str:
@@ -343,6 +352,54 @@ class Runner:
         with open(path) as f:
             return json.load(f)
 
+    def scrape_metrics(self, name: str, timeout: float = 3.0) -> str:
+        """Fetch `name`'s prometheus exposition text (GET /metrics)."""
+        node = self.nodes[name]
+        url = f"http://127.0.0.1:{node.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+
+    # series every live full node must expose once the chain is moving:
+    # one representative per instrumented subsystem
+    KEY_SERIES = (
+        "cometbft_consensus_height",
+        "cometbft_consensus_step_duration_seconds",
+        "cometbft_mempool_size",
+        "cometbft_p2p_peers",
+        "cometbft_p2p_peer_height",
+        "cometbft_state_block_processing_time",
+        "cometbft_blocksync_syncing",
+        "cometbft_crypto_path_selected_total",
+    )
+
+    def check_metrics(self) -> dict:
+        """Scrape every live node's /metrics and assert the key series
+        are present with sane values on at least one of them (perturbed
+        or old-build nodes may legitimately not answer)."""
+        per_node: dict[str, list[str]] = {}
+        ok_nodes = []
+        for name, n in self.nodes.items():
+            if n.proc is None or n.command is not None:
+                continue  # stopped, or an old build without /metrics
+            try:
+                text = self.scrape_metrics(name)
+            except Exception:  # noqa: BLE001 — perturbed/paused node
+                per_node[name] = ["<unreachable>"]
+                continue
+            missing = [s for s in self.KEY_SERIES if s not in text]
+            height = 0.0
+            for line in text.splitlines():
+                if line.startswith("cometbft_consensus_height "):
+                    height = float(line.split()[-1])
+            if height <= 0:
+                missing.append("cometbft_consensus_height>0")
+            per_node[name] = missing
+            if not missing:
+                ok_nodes.append(name)
+        if per_node and not ok_nodes:
+            raise E2EError(f"no node passed the metrics check: {per_node}")
+        return per_node
+
     def max_height(self) -> int:
         return max(
             (n.height() for name, n in self.nodes.items()
@@ -394,6 +451,9 @@ class Runner:
             self.wait_for_height(
                 m.target_height, max(deadline - time.monotonic(), 1.0)
             )
+            # metrics invariant while the nodes are still live: at least
+            # one node exposes every key series with a positive height
+            self.check_metrics()
         finally:
             self.stop_all()
         self.check_invariants()

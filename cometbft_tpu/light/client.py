@@ -207,6 +207,9 @@ class LightClient:
     # ------------------------------------------------------------------
     def _verify_one(self, trusted: LightBlock, new: LightBlock, now: Timestamp
                     ) -> None:
+        from ..utils.metrics import light_metrics
+
+        light_metrics().headers_verified_total.inc()
         if new.height == trusted.height + 1:
             verify_adjacent(
                 self.chain_id, trusted.signed_header, new.signed_header,
@@ -269,6 +272,9 @@ class LightClient:
                 mid_lb = self.primary.light_block(mid)
                 if mid_lb is None:
                     raise ErrInvalidHeader(f"primary missing pivot height {mid}")
+                from ..utils.metrics import light_metrics
+
+                light_metrics().bisections_total.inc()
                 pivots.append(mid_lb)
                 continue
             self.store.save(pivot)

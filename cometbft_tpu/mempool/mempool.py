@@ -176,6 +176,8 @@ class CListMempool:
                 self.cache.remove(key)
             self._txs.pop(key, None)
         # recheck survivors against the new app state
+        if self._txs:
+            mempool_metrics().recheck_times.inc()
         for key in list(self._txs.keys()):
             t = self._txs[key]
             resp = self.app.mempool.check_tx(t.tx)
@@ -183,11 +185,13 @@ class CListMempool:
                 self._txs.pop(key, None)
                 if not self.keep_invalid:
                     self.cache.remove(key)
+        mempool_metrics().size.set(len(self._txs))
 
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
             self.cache.reset()
+            mempool_metrics().size.set(0)
 
     def txs_available(self) -> bool:
         return bool(self._txs)

@@ -43,6 +43,30 @@ class Node:
         config.validate()
         home = config.base.home
 
+        # --- observability ---------------------------------------------
+        # Namespace must be applied before any subsystem constructs its
+        # metrics bundle (bundle names are frozen at registration time).
+        from ..utils import metrics as _metrics
+        from ..utils import trace as _trace
+
+        _metrics.set_namespace(config.instrumentation.namespace)
+        # Register every bundle up front (reference node.go creates all
+        # subsystem metrics at construction): /metrics then shows the
+        # full inventory from the first scrape, zeros included, instead
+        # of series popping into existence when a subsystem first runs.
+        for _mk in (
+            _metrics.consensus_metrics, _metrics.mempool_metrics,
+            _metrics.p2p_metrics, _metrics.state_metrics,
+            _metrics.blocksync_metrics, _metrics.statesync_metrics,
+            _metrics.light_metrics, _metrics.crypto_metrics,
+        ):
+            _mk()
+        if config.instrumentation.trace_sink and not _trace.enabled:
+            sink = config.instrumentation.trace_sink
+            if not os.path.isabs(sink):
+                sink = os.path.join(home, sink)
+            _trace.configure(sink)
+
         def _p(rel: str) -> str:
             path = os.path.join(home, rel)
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -22,6 +22,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..utils.metrics import p2p_metrics
+
 PACKET_DATA = 1
 PACKET_PING = 2
 PACKET_PONG = 3
@@ -173,6 +175,9 @@ class MConnection:
                     "<BHB", PACKET_DATA, ch.desc.id, 1 if eof else 0
                 ) + chunk
                 self._conn.write_msg(frame)
+                p2p_metrics().message_send_bytes_total.inc(
+                    len(frame), f"{ch.desc.id:#04x}"
+                )
                 self._send_limit.spend(len(frame), self._stopped)
         except Exception as e:  # noqa: BLE001
             if not self._stopped.is_set():
@@ -206,6 +211,9 @@ class MConnection:
                 if eof:
                     msg = b"".join(ch.recv_parts)
                     ch.recv_parts, ch.recv_size = [], 0
+                    p2p_metrics().message_receive_bytes_total.inc(
+                        len(msg), f"{chan_id:#04x}"
+                    )
                     self._on_receive(chan_id, msg)
         except Exception as e:  # noqa: BLE001
             if not self._stopped.is_set():

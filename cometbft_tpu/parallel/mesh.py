@@ -17,7 +17,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5: top-level export, replication check kwarg is check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
 
 from ..ops import ed25519_verify
 
@@ -64,12 +72,12 @@ def sharded_verify_fn(mesh: Mesh, axes: str | tuple[str, ...] = "sig"):
         return bad == 0, bits
 
     spec_b = P(axes_t if len(axes_t) > 1 else axes_t[0])
-    fn = shard_map(
+    fn = _shard_map(
         local,
         mesh=mesh,
         in_specs=(spec_b,) * 6,
         out_specs=(P(), spec_b),
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     return jax.jit(fn)
 

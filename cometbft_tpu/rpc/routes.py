@@ -116,6 +116,23 @@ def health(env, params):
     return {}
 
 
+def dump_trace(env, params):
+    """Tail of the node's trace sink (observability debug aid).
+
+    Returns the last `n` JSONL records (default 100) written by
+    utils.trace; empty when tracing is disabled.
+    """
+    from ..utils import trace
+
+    n = int(params.get("n", 100) or 100)
+    n = max(1, min(n, 1000))
+    return {
+        "enabled": trace.enabled,
+        "path": trace.path() or "",
+        "records": trace.tail(n) if trace.enabled else [],
+    }
+
+
 def status(env, params):
     bs = env.block_store
     latest = bs.height() if bs else 0
@@ -739,6 +756,7 @@ UNSAFE_ROUTES = {
 
 ROUTES = {
     "health": health,
+    "dump_trace": dump_trace,
     "status": status,
     "broadcast_evidence": broadcast_evidence,
     "genesis_chunked": genesis_chunked,

@@ -312,6 +312,7 @@ class BlockExecutor:
         import time as _time
 
         from ..abci.types import FinalizeBlockRequest
+        from ..utils import trace
         from ..utils.fail import fail_point
         from ..utils.metrics import state_metrics
 
@@ -330,6 +331,7 @@ class BlockExecutor:
                 block.evidence, state.consensus_params.evidence.max_bytes
             )
 
+        t_validate = _time.perf_counter()
         fail_point()  # reference execution.go:251 (pre-FinalizeBlock)
         resp = self.app.consensus.finalize_block(
             FinalizeBlockRequest(
@@ -349,6 +351,7 @@ class BlockExecutor:
         if len(resp.tx_results) != len(block.data.txs):
             raise BlockValidationError("app returned wrong number of tx results")
 
+        t_finalize = _time.perf_counter()
         fail_point()  # reference execution.go:258 (post-FinalizeBlock, pre-save)
         new_state = self._update_state(state, block_id, block, resp)
 
@@ -373,6 +376,7 @@ class BlockExecutor:
         if self.evidence_pool is not None:
             self.evidence_pool.update(new_state, block.evidence)
 
+        t_commit = _time.perf_counter()
         fail_point()  # reference execution.go:301 (post-Commit, pre-save)
         if self.state_store is not None:
             self.state_store.save(new_state)
@@ -397,9 +401,20 @@ class BlockExecutor:
                 )
         for handler in self.event_handlers:
             handler(block, resp)
-        state_metrics().block_processing_time.observe(
-            _time.perf_counter() - t0
-        )
+        t_end = _time.perf_counter()
+        state_metrics().block_processing_time.observe(t_end - t0)
+        if trace.enabled:
+            # One span per ApplyBlock carrying the per-stage breakdown
+            # (validate = commit-sig verification, i.e. the crypto path).
+            trace.emit(
+                "state.apply_block", "span",
+                height=block.header.height, txs=len(block.data.txs),
+                dur_ms=round((t_end - t0) * 1e3, 3),
+                validate_ms=round((t_validate - t0) * 1e3, 3),
+                finalize_ms=round((t_finalize - t_validate) * 1e3, 3),
+                commit_ms=round((t_commit - t_finalize) * 1e3, 3),
+                save_events_ms=round((t_end - t_commit) * 1e3, 3),
+            )
         return new_state
 
     def apply_block_preverified(self, state: State, block_id: BlockID, block: Block) -> State:

@@ -74,11 +74,25 @@ class Syncer:
 
     # ------------------------------------------------------------------
     def add_snapshot(self, snapshot: Snapshot, peer: str = "") -> bool:
-        return self.pool.add(snapshot, peer)
+        added = self.pool.add(snapshot, peer)
+        if added:
+            from ..utils.metrics import statesync_metrics
+
+            statesync_metrics().snapshots_discovered_total.inc()
+        return added
 
     def sync_any(self, max_attempts: int = 10):
         """Try pool snapshots best-first until one restores; returns
         (state, commit) (reference SyncAny :144)."""
+        from ..utils.metrics import statesync_metrics
+
+        statesync_metrics().syncing.set(1)
+        try:
+            return self._sync_any(max_attempts)
+        finally:
+            statesync_metrics().syncing.set(0)
+
+    def _sync_any(self, max_attempts: int):
         attempts = 0
         while attempts < max_attempts:
             snapshot = self.pool.best()
@@ -220,6 +234,9 @@ class Syncer:
             result = self.conn.apply_snapshot_chunk(index, data, sender)
             if result == ApplySnapshotChunkResult.ACCEPT:
                 applied += 1
+                from ..utils.metrics import statesync_metrics
+
+                statesync_metrics().chunks_applied_total.inc()
                 continue
             if result == ApplySnapshotChunkResult.ABORT:
                 raise ErrAbort("app aborted during chunk apply")

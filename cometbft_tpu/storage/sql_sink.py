@@ -159,7 +159,10 @@ class SQLSink:
             try:
                 return list(self._db.execute(sql, params))
             finally:
-                self._db.set_authorizer(None)
+                # restore with an explicit allow-all: on some sqlite
+                # builds set_authorizer(None) leaves the deny callback
+                # installed and every later write fails "not authorized"
+                self._db.set_authorizer(lambda *a: sqlite3.SQLITE_OK)
 
     def close(self) -> None:
         with self._lock:

@@ -4,7 +4,10 @@ scripts/metricsgen).
 A minimal registry with Counter / Gauge / Histogram supporting labels
 and the text exposition format, served by `MetricsServer` at the
 instrumentation listen address (reference node/node.go:537). Subsystem
-metric bundles mirror the reference's generated structs.
+metric bundles mirror the reference's generated structs; singleton
+accessors (`consensus_metrics()` ...) hand the hot paths their bundle
+against `DEFAULT_REGISTRY`, and `reset_bundles()` clears everything so
+metric state cannot leak across tests.
 """
 
 from __future__ import annotations
@@ -13,6 +16,25 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 NAMESPACE = "cometbft"
+
+
+def set_namespace(ns: str) -> None:
+    """Set the metric-name prefix (config [instrumentation] namespace).
+
+    Affects metrics registered after the call; node startup invokes it
+    before any subsystem bundle is created.
+    """
+    global NAMESPACE
+    if ns:
+        NAMESPACE = ns
+
+
+def _escape_label(v) -> str:
+    # Prometheus text format: backslash, double-quote and newline must
+    # be escaped inside label values.
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
 
 
 class _Metric:
@@ -34,9 +56,14 @@ class _Metric:
         if not self.labels:
             return ""
         pairs = ",".join(
-            f'{k}="{v}"' for k, v in zip(self.labels, key)
+            f'{k}="{_escape_label(v)}"' for k, v in zip(self.labels, key)
         )
         return "{" + pairs + "}"
+
+    def values(self) -> dict[tuple, float]:
+        """Snapshot of current samples keyed by label-value tuple."""
+        with self._lock:
+            return dict(self._values)
 
 
 class Counter(_Metric):
@@ -67,6 +94,12 @@ class Gauge(_Metric):
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def remove(self, *labels) -> None:
+        """Drop one labelled series (e.g. a disconnected peer's gauge)."""
+        key = self._key(tuple(labels))
+        with self._lock:
+            self._values.pop(key, None)
+
     def expose(self) -> list[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -96,17 +129,23 @@ class Histogram(_Metric):
             counts[-1] += 1  # +Inf
             self._sums[key] = self._sums.get(key, 0.0) + value
 
+    def snapshot(self) -> dict[tuple, dict]:
+        """{labels: {"count": n, "sum": s}} for programmatic readers."""
+        with self._lock:
+            return {
+                k: {"count": c[-1], "sum": self._sums.get(k, 0.0)}
+                for k, c in self._counts.items()
+            }
+
     def expose(self) -> list[str]:
         out = []
         with self._lock:
             for key, counts in sorted(self._counts.items()):
-                cum = 0
                 base = self._fmt_labels(key)[1:-1] if self.labels else ""
                 for i, b in enumerate(self.buckets):
-                    cum = counts[i]
                     le = f'le="{b}"'
                     lbl = "{" + (base + "," if base else "") + le + "}"
-                    out.append(f"{self.name}_bucket{lbl} {cum}")
+                    out.append(f"{self.name}_bucket{lbl} {counts[i]}")
                 lbl = "{" + (base + "," if base else "") + 'le="+Inf"' + "}"
                 out.append(f"{self.name}_bucket{lbl} {counts[-1]}")
                 sfx = "{" + base + "}" if base else ""
@@ -118,6 +157,7 @@ class Histogram(_Metric):
 class Registry:
     def __init__(self):
         self._metrics: list[_Metric] = []
+        self._names: set[str] = set()
         self._lock = threading.Lock()
 
     def counter(self, subsystem: str, name: str, help_: str = "",
@@ -139,8 +179,16 @@ class Registry:
 
     def _add(self, m: _Metric):
         with self._lock:
+            if m.name in self._names:
+                raise ValueError(f"metric {m.name!r} already registered")
+            self._names.add(m.name)
             self._metrics.append(m)
         return m
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._names.clear()
 
     def expose_text(self) -> str:
         lines = []
@@ -176,6 +224,9 @@ class ConsensusMetrics:
                                           "Last block size")
         self.total_txs = reg.counter("consensus", "total_txs",
                                      "Total committed txs")
+        self.step_duration_seconds = reg.histogram(
+            "consensus", "step_duration_seconds",
+            "Time spent in each consensus step", labels=("step",))
 
 
 class MempoolMetrics:
@@ -198,6 +249,14 @@ class P2PMetrics:
         self.message_send_bytes_total = reg.counter(
             "p2p", "message_send_bytes_total", "Bytes sent",
             labels=("chan",))
+        # Per-peer reactor state (VERDICT Next #3: the rejoin-stall
+        # debugging data) — fed from the consensus reactor's PeerState.
+        self.peer_height = reg.gauge(
+            "p2p", "peer_height", "Last known consensus height per peer",
+            labels=("peer",))
+        self.peer_round = reg.gauge(
+            "p2p", "peer_round", "Last known consensus round per peer",
+            labels=("peer",))
 
 
 class StateMetrics:
@@ -211,39 +270,139 @@ class StateMetrics:
             "Commit signature verification wall time (TPU kernel path)")
 
 
+class BlockSyncMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.syncing = reg.gauge("blocksync", "syncing",
+                                 "1 while block sync is running")
+        self.latest_block_height = reg.gauge(
+            "blocksync", "latest_block_height",
+            "Highest height applied by block sync")
+        self.num_peers = reg.gauge("blocksync", "num_peers",
+                                   "Peers in the block pool")
+        self.pending_requests = reg.gauge(
+            "blocksync", "pending_requests",
+            "In-flight block requests without a block yet")
+        self.peer_height = reg.gauge(
+            "blocksync", "peer_height",
+            "Reported chain height per pool peer", labels=("peer",))
+        self.blocks_applied_total = reg.counter(
+            "blocksync", "blocks_applied_total",
+            "Blocks verified and applied by block sync")
+        self.bad_blocks_total = reg.counter(
+            "blocksync", "bad_blocks_total",
+            "Blocks that failed verification (request redone)")
+
+
+class StateSyncMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.syncing = reg.gauge("statesync", "syncing",
+                                 "1 while state sync is running")
+        self.snapshots_discovered_total = reg.counter(
+            "statesync", "snapshots_discovered_total",
+            "Snapshots offered by peers")
+        self.chunks_applied_total = reg.counter(
+            "statesync", "chunks_applied_total",
+            "Snapshot chunks accepted by the app")
+
+
+class LightClientMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.headers_verified_total = reg.counter(
+            "light", "headers_verified_total",
+            "Light blocks verified (sequential + skipping)")
+        self.bisections_total = reg.counter(
+            "light", "bisections_total",
+            "Bisection steps taken during skipping verification")
+
+
+class CryptoMetrics:
+    BATCH_BUCKETS = (1, 64, 256, 1024, 4096, 10240, 16384, 65536)
+
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.batch_size = reg.histogram(
+            "crypto", "batch_size", "Ed25519 batch-verify sizes",
+            buckets=self.BATCH_BUCKETS)
+        self.path_selected_total = reg.counter(
+            "crypto", "path_selected_total",
+            "Dispatch decisions per verify path "
+            "(native/rlc/ladder/delta/cpu)", labels=("path",))
+        self.verify_seconds = reg.histogram(
+            "crypto", "verify_seconds",
+            "Batch-verify wall time submit→result", labels=("path",))
+        self.calibration_us_per_sig = reg.gauge(
+            "crypto", "calibration_us_per_sig",
+            "Calibrated host-stage dispatch terms", labels=("term",))
+
+
 _BUNDLES: dict[str, object] = {}
+_BUNDLES_LOCK = threading.Lock()
+
+
+def _bundle(name: str, cls):
+    b = _BUNDLES.get(name)
+    if b is None:
+        with _BUNDLES_LOCK:
+            b = _BUNDLES.get(name)
+            if b is None:
+                b = _BUNDLES[name] = cls()
+    return b
 
 
 def consensus_metrics() -> ConsensusMetrics:
-    b = _BUNDLES.get("consensus")
-    if b is None:
-        b = _BUNDLES["consensus"] = ConsensusMetrics()
-    return b
+    return _bundle("consensus", ConsensusMetrics)
 
 
 def mempool_metrics() -> MempoolMetrics:
-    b = _BUNDLES.get("mempool")
-    if b is None:
-        b = _BUNDLES["mempool"] = MempoolMetrics()
-    return b
+    return _bundle("mempool", MempoolMetrics)
 
 
 def p2p_metrics() -> P2PMetrics:
-    b = _BUNDLES.get("p2p")
-    if b is None:
-        b = _BUNDLES["p2p"] = P2PMetrics()
-    return b
+    return _bundle("p2p", P2PMetrics)
 
 
 def state_metrics() -> StateMetrics:
-    b = _BUNDLES.get("state")
-    if b is None:
-        b = _BUNDLES["state"] = StateMetrics()
-    return b
+    return _bundle("state", StateMetrics)
+
+
+def blocksync_metrics() -> BlockSyncMetrics:
+    return _bundle("blocksync", BlockSyncMetrics)
+
+
+def statesync_metrics() -> StateSyncMetrics:
+    return _bundle("statesync", StateSyncMetrics)
+
+
+def light_metrics() -> LightClientMetrics:
+    return _bundle("light", LightClientMetrics)
+
+
+def crypto_metrics() -> CryptoMetrics:
+    return _bundle("crypto", CryptoMetrics)
+
+
+def reset_bundles() -> None:
+    """Test hook: drop all bundles and empty DEFAULT_REGISTRY in place.
+
+    In-place (`Registry.clear`) so references held by a live
+    `MetricsServer` keep working; the duplicate-name guard permits
+    re-registration after the clear.
+    """
+    with _BUNDLES_LOCK:
+        _BUNDLES.clear()
+        DEFAULT_REGISTRY.clear()
 
 
 class MetricsServer:
-    """Serves the registry at /metrics (reference prometheus listener)."""
+    """Serves the registry at /metrics (reference prometheus listener).
+
+    Only `GET /metrics` is answered; other paths get 404, other methods
+    405 — matching what a prometheus scraper expects from a metrics
+    endpoint.
+    """
 
     def __init__(self, registry: Registry | None = None,
                  host: str = "127.0.0.1", port: int = 0):
@@ -253,7 +412,18 @@ class MetricsServer:
             def log_message(self, *a):
                 pass
 
+            def _refuse(self, code: int, msg: str):
+                body = msg.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self._refuse(404, "not found; metrics at /metrics\n")
+                    return
                 body = reg.expose_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -261,6 +431,15 @@ class MetricsServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _method_not_allowed(self):
+                self._refuse(405, "method not allowed\n")
+
+            do_POST = _method_not_allowed
+            do_PUT = _method_not_allowed
+            do_DELETE = _method_not_allowed
+            do_PATCH = _method_not_allowed
+            do_HEAD = _method_not_allowed
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.addr = self._httpd.server_address

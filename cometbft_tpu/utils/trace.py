@@ -1,0 +1,153 @@
+"""Lightweight span/event tracer writing JSONL to a configurable sink.
+
+The reference ships OpenTelemetry-style consensus tracing out of tree;
+here a single-process JSONL tracer is enough to attribute wall time
+across consensus steps, ApplyBlock stages, blocksync fetch→verify→apply
+and crypto batch-verify dispatch (ISSUE 3 tentpole part 1).
+
+Design constraints:
+
+* Near-zero overhead when disabled. `enabled` is a plain module bool;
+  hot paths guard with ``if trace.enabled:`` so the disabled cost is one
+  global load. `span()` returns a shared no-op context manager so
+  un-guarded ``with trace.span(...)`` sites stay cheap too.
+* One JSON object per line, flushed per record so a killed node leaves
+  a readable trace. Every record carries ``ts`` (epoch seconds), ``pid``
+  (merge safety across e2e nodes), ``name`` and ``kind`` ("span" or
+  "event"); spans add ``dur_ms``; callers attach free-form fields.
+* Sink selection: `configure(path)` from node config
+  (``[instrumentation] trace_sink``), or the ``COMETBFT_TPU_TRACE``
+  environment variable at import time (picked up by subprocess nodes
+  and bench.py without config plumbing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+enabled = False
+_path: str | None = None
+_fh = None
+_lock = threading.Lock()
+_pid = os.getpid()
+
+
+def configure(path: str) -> None:
+    """Open (append) the JSONL sink at `path` and enable tracing."""
+    global enabled, _path, _fh, _pid
+    with _lock:
+        if _fh is not None:
+            _fh.close()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _fh = open(path, "a", encoding="utf-8")
+        _path = path
+        _pid = os.getpid()
+        enabled = True
+
+
+def disable() -> None:
+    global enabled, _path, _fh
+    with _lock:
+        enabled = False
+        if _fh is not None:
+            _fh.close()
+        _fh = None
+        _path = None
+
+
+def path() -> str | None:
+    return _path
+
+
+def emit(name: str, kind: str = "event", **fields) -> None:
+    """Write one record. No-op (single bool check) when disabled."""
+    if not enabled:
+        return
+    rec = {"ts": time.time(), "pid": _pid, "name": name, "kind": kind}
+    rec.update(fields)
+    line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+    with _lock:
+        if _fh is None:  # raced with disable()
+            return
+        _fh.write(line)
+        _fh.flush()
+
+
+def event(name: str, **fields) -> None:
+    emit(name, "event", **fields)
+
+
+class _Span:
+    __slots__ = ("name", "fields", "_t0")
+
+    def __init__(self, name: str, fields: dict):
+        self.name = name
+        self.fields = fields
+
+    def add(self, **fields) -> None:
+        self.fields.update(fields)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        emit(self.name, "span", dur_ms=round(dur_ms, 3), **self.fields)
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def add(self, **fields) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, **fields):
+    """Context manager timing a block; writes one span record on exit."""
+    if not enabled:
+        return _NOOP
+    return _Span(name, fields)
+
+
+def tail(n: int = 100) -> list[dict]:
+    """Last `n` parsed records from the sink (for the dump_trace RPC)."""
+    p = _path
+    if p is None or not os.path.exists(p):
+        return []
+    with _lock:
+        if _fh is not None:
+            _fh.flush()
+    with open(p, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - 256 * 1024))
+        lines = f.read().decode("utf-8", "replace").splitlines()
+    out = []
+    for line in lines[-n:]:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+_env = os.environ.get("COMETBFT_TPU_TRACE")
+if _env:
+    configure(_env)
+del _env

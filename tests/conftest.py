@@ -25,3 +25,17 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metric_bundles():
+    """Every test starts with empty singleton metric bundles: counters
+    incremented by one test must not leak into another's assertions
+    (utils.metrics.reset_bundles clears the default registry in place,
+    so a live MetricsServer keeps serving the same Registry object)."""
+    from cometbft_tpu.utils import metrics
+
+    metrics.reset_bundles()
+    yield

@@ -2,9 +2,18 @@
 pause / restart perturbations under tx load, black-box hash-agreement
 invariants (reference test/e2e/runner + test/e2e/runner/perturb.go)."""
 
+import os
 import time
 
+import pytest
+
 from cometbft_tpu.e2e import Manifest, Runner
+
+# The larger nets run one consensus subprocess per node with sub-second
+# timeouts; on a host without real parallelism the processes starve the
+# scheduler and miss heights/crawl cadences for environmental reasons,
+# not product bugs. Probe the actual core count, not an env var.
+_CORES = os.cpu_count() or 1
 
 
 def test_e2e_perturbed_testnet(tmp_path):
@@ -30,6 +39,11 @@ def test_e2e_perturbed_testnet(tmp_path):
     assert sum(1 for h in report["heights"].values() if h >= 10) >= 2
 
 
+@pytest.mark.skipif(
+    _CORES < 4,
+    reason=f"7-node subprocess net needs >=4 cores to meet sub-second "
+           f"consensus timeouts (host has {_CORES})",
+)
 def test_e2e_seven_nodes_quorum_split(tmp_path):
     """7 validators (f=2), vote extensions on, and a 3-vs-4 partition
     that straddles the quorum boundary: 30/70 and 40/70 voting power are
@@ -133,6 +147,11 @@ def test_e2e_random_manifest_with_partition(tmp_path):
     assert lat["count"] > 0 and lat["p50_s"] > 0
 
 
+@pytest.mark.skipif(
+    _CORES < 2,
+    reason=f"seed crawl-and-disconnect cadence sampling is scheduling-"
+           f"sensitive; needs >=2 cores (host has {_CORES})",
+)
 def test_e2e_seed_only_bootstrap(tmp_path):
     """Seed-only discovery: 3 validators with NO persistent peers and
     one seed-mode node. The net must assemble itself purely through PEX

@@ -1,12 +1,18 @@
 """Metrics, structured logging, and fail-point crash injection
 (reference metrics.go bundles, libs/log, internal/fail)."""
 
+import json
 import os
+import re
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 
+import pytest
+
 from cometbft_tpu.utils import log as cmtlog
+from cometbft_tpu.utils import metrics as M
 from cometbft_tpu.utils.metrics import (
     Counter,
     Gauge,
@@ -14,6 +20,56 @@ from cometbft_tpu.utils.metrics import (
     MetricsServer,
     Registry,
 )
+
+# ------------------------------------------------------- mini parser
+# A small but honest prometheus text-format parser: enough to round-trip
+# what Registry.expose_text() emits (HELP/TYPE metadata, escaped label
+# values, histogram bucket series) and catch format regressions.
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(
+                v[i + 1], v[i + 1]))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str):
+    """-> (helps, types, samples) with samples keyed
+    (name, ((label, value), ...))."""
+    helps, types, samples = {}, {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, h = line[len("# HELP "):].partition(" ")
+            helps[name] = h
+            continue
+        if line.startswith("# TYPE "):
+            name, _, t = line[len("# TYPE "):].partition(" ")
+            types[name] = t
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, raw_labels, value = m.groups()
+        labels = tuple(
+            (k, _unescape(v))
+            for k, v in _LABEL_RE.findall(raw_labels or "")
+        )
+        samples[(name, labels)] = float(value)
+    return helps, types, samples
 
 
 def test_metrics_exposition_format():
@@ -49,6 +105,105 @@ def test_metrics_server_serves_text():
         srv.stop()
 
 
+def test_metrics_exposition_round_trip():
+    """expose_text() -> mini parser -> the exact values and label
+    strings that went in (including prometheus escape sequences)."""
+    reg = Registry()
+    c = reg.counter("consensus", "total_txs", "Total transactions seen")
+    g = reg.gauge("p2p", "peer_height", "Peer height", labels=("peer",))
+    h = reg.histogram("crypto", "batch_size", "Batch sizes",
+                      buckets=(1, 64, 256))
+    c.inc(5)
+    nasty = 'quote"back\\slash\nnewline'
+    g.set(17, nasty)
+    g.set(9, "plainpeer")
+    for v in (1, 2, 200, 999):
+        h.observe(v)
+    helps, types, samples = parse_exposition(reg.expose_text())
+
+    assert types["cometbft_consensus_total_txs"] == "counter"
+    assert types["cometbft_p2p_peer_height"] == "gauge"
+    assert types["cometbft_crypto_batch_size"] == "histogram"
+    assert helps["cometbft_consensus_total_txs"] == (
+        "Total transactions seen"
+    )
+    assert samples[("cometbft_consensus_total_txs", ())] == 5.0
+    # label escaping round-trips bytes-for-bytes
+    assert samples[
+        ("cometbft_p2p_peer_height", (("peer", nasty),))
+    ] == 17.0
+    assert samples[
+        ("cometbft_p2p_peer_height", (("peer", "plainpeer"),))
+    ] == 9.0
+    # histogram: cumulative buckets, +Inf == _count, _sum preserved
+    buckets = {
+        dict(labels)["le"]: v
+        for (name, labels), v in samples.items()
+        if name == "cometbft_crypto_batch_size_bucket"
+    }
+    assert buckets == {"1": 1.0, "64": 2.0, "256": 3.0, "+Inf": 4.0}
+    cum = [buckets[le] for le in ("1", "64", "256", "+Inf")]
+    assert cum == sorted(cum), "bucket counts must be cumulative"
+    assert samples[("cometbft_crypto_batch_size_count", ())] == 4.0
+    assert samples[("cometbft_crypto_batch_size_sum", ())] == 1202.0
+
+
+def test_registry_duplicate_name_guard():
+    reg = Registry()
+    reg.counter("consensus", "height", "first registration")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("consensus", "height", "duplicate")
+    with pytest.raises(ValueError, match="already registered"):
+        # a different kind under the same name is just as wrong
+        reg.gauge("consensus", "height", "duplicate as gauge")
+
+
+def test_metrics_server_404_and_405():
+    srv = MetricsServer(registry=Registry())
+    srv.start()
+    try:
+        host, port = srv.addr
+        base = f"http://{host}:{port}"
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            urllib.request.urlopen(f"{base}/other", timeout=5)
+        assert e404.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e405:
+            urllib.request.urlopen(f"{base}/metrics", data=b"x", timeout=5)
+        assert e405.value.code == 405
+        # the real path still answers
+        resp = urllib.request.urlopen(f"{base}/metrics", timeout=5)
+        assert resp.status == 200
+    finally:
+        srv.stop()
+
+
+def test_reset_bundles_gives_fresh_singletons():
+    cm = M.consensus_metrics()
+    cm.height.set(42)
+    assert M.consensus_metrics() is cm
+    text = M.DEFAULT_REGISTRY.expose_text()
+    assert "cometbft_consensus_height 42" in text
+    reg_before = M.DEFAULT_REGISTRY
+    M.reset_bundles()
+    # same Registry object (live MetricsServers keep serving it) but
+    # emptied, and the next accessor call builds a fresh bundle
+    assert M.DEFAULT_REGISTRY is reg_before
+    assert M.consensus_metrics() is not cm
+    assert M.consensus_metrics().height.values() == {}
+
+
+def test_metrics_lint_all_bundles_driven():
+    """tools/metrics_lint.py: every registered metric has a driver
+    call site in the package (a zero-forever metric fails tier 1)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "metrics_lint.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+
+
 def test_logger_levels_and_fields():
     records = []
     cmtlog.set_sink(lambda level, msg, fields: records.append((level, msg, fields)))
@@ -68,6 +223,153 @@ def test_logger_levels_and_fields():
     finally:
         cmtlog.set_sink(cmtlog._Config._stderr_sink)
         cmtlog.set_level("info")
+
+
+def _mk_obs_node(tmp_path, name, key, genesis, peers="",
+                 instrument=False):
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+
+    home = os.path.join(str(tmp_path), name)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        json.dump(key, f)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = name
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.persistent_peers = peers
+    cfg.consensus.timeout_propose = 0.6
+    cfg.consensus.timeout_propose_delta = 0.2
+    cfg.consensus.timeout_prevote = 0.3
+    cfg.consensus.timeout_prevote_delta = 0.1
+    cfg.consensus.timeout_precommit = 0.3
+    cfg.consensus.timeout_precommit_delta = 0.1
+    cfg.consensus.timeout_commit = 0.1
+    if instrument:
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        cfg.instrumentation.trace_sink = "data/trace.jsonl"
+    return Node(cfg, app=KVStoreApp())
+
+
+def test_node_serves_metrics_and_trace(tmp_path):
+    """Full-node observability: a two-validator net with
+    instrumentation on exposes live series from every subsystem on
+    /metrics while it commits (2-signature commits cross the
+    batch-verify threshold, so the crypto dispatch and per-peer gauges
+    are all driven), writes consensus/ApplyBlock/crypto spans to the
+    trace sink, and serves the tail over the dump_trace RPC."""
+    import time as _time
+
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import Timestamp
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.utils import trace
+
+    pvs = [FilePV.generate(None, None) for _ in range(2)]
+    genesis = GenesisDoc(
+        chain_id="obs-chain",
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[
+            GenesisValidator(pv.pub_key().bytes(), 10, f"v{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    keys = [
+        {
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }
+        for pv in pvs
+    ]
+    n = _mk_obs_node(tmp_path, "n0", keys[0], genesis, instrument=True)
+    n.start()
+    phost, pport = n.listen_addr
+    n1 = _mk_obs_node(tmp_path, "n1", keys[1], genesis,
+                      peers=f"{phost}:{pport}")
+    n1.start()
+    home = n.config.base.home
+    try:
+        deadline = _time.monotonic() + 150
+        while (_time.monotonic() < deadline
+               and n.consensus.sm_state.last_block_height < 3):
+            _time.sleep(0.2)
+        assert n.consensus.sm_state.last_block_height >= 3, "chain stalled"
+
+        host, port = n.metrics_server.addr
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ).read().decode()
+        _helps, types, samples = parse_exposition(text)
+        # live series from >= 6 subsystems
+        height = samples[("cometbft_consensus_height", ())]
+        assert height >= 3
+        assert types["cometbft_consensus_step_duration_seconds"] == (
+            "histogram"
+        )
+        assert samples[
+            ("cometbft_consensus_step_duration_seconds_count",
+             (("step", "COMMIT"),))
+        ] >= 1
+        assert ("cometbft_mempool_size", ()) in samples
+        assert ("cometbft_p2p_peers", ()) in samples
+        assert samples[
+            ("cometbft_state_block_processing_time_count", ())
+        ] >= 1
+        assert ("cometbft_blocksync_syncing", ()) in samples
+        # per-peer height gauge (VERDICT #3's rejoin-stall data)
+        peer_heights = [
+            labels for (name, labels) in samples
+            if name == "cometbft_p2p_peer_height" and labels
+        ]
+        assert peer_heights, "connected peer must drive peer_height gauge"
+        # 2-sig commits cross BATCH_VERIFY_THRESHOLD: a batch path
+        # ("cpu"/"native") fires, plus "single" for gossiped votes
+        crypto_paths = {
+            dict(labels).get("path")
+            for (name, labels) in samples
+            if name == "cometbft_crypto_path_selected_total"
+        }
+        assert crypto_paths & {"cpu", "native"}, crypto_paths
+
+        # the trace sink holds consensus-step, ApplyBlock and crypto
+        # batch-verify spans
+        sink = os.path.join(home, "data", "trace.jsonl")
+        recs = [json.loads(line) for line in open(sink, encoding="utf-8")]
+        steps = [r for r in recs if r["name"] == "consensus.step"]
+        assert steps and all("height" in r and "round" in r for r in steps)
+        assert any(r["name"] == "state.apply_block" for r in recs)
+        crypto_spans = [
+            r for r in recs if r["name"] == "crypto.batch_verify"
+        ]
+        assert crypto_spans, "batch verification must be traced"
+        assert all(
+            r["kind"] == "span" and r["path"] and r["n"] >= 1
+            for r in crypto_spans
+        )
+
+        # dump_trace RPC serves the same tail (GET-URI dispatch)
+        rhost, rport = n.rpc_addr
+        out = json.loads(urllib.request.urlopen(
+            f"http://{rhost}:{rport}/dump_trace?n=50", timeout=5
+        ).read())
+        res = out["result"]
+        assert res["enabled"] is True
+        assert res["path"].endswith("trace.jsonl")
+        assert any(
+            r["name"].startswith("consensus.") for r in res["records"]
+        )
+    finally:
+        n1.stop()
+        n.stop()
+        trace.disable()
 
 
 _CRASH_SCRIPT = r"""
