@@ -228,6 +228,39 @@ def _rlc_beats_ladder(n: int, b: int) -> bool:
 # it (csrc/ed25519_ifma.inc), portable C++ otherwise.
 NATIVE_MAX = 1024
 
+# Probed once: is jax backed by a real accelerator? When it is not,
+# the "device" paths are XLA emulating the Pallas graphs on this same
+# host — strictly dominated by the native C++ engine at every batch
+# size, and their XLA compiles at mega-batch shapes take minutes on a
+# small host. Dispatch must not send work to a device that does not
+# exist.
+_ACCEL_BACKED = None
+
+
+def _accel_backed() -> bool:
+    global _ACCEL_BACKED
+    if _ACCEL_BACKED is None:
+        try:
+            import jax
+
+            _ACCEL_BACKED = jax.default_backend() != "cpu"
+        except Exception:
+            _ACCEL_BACKED = False
+    return _ACCEL_BACKED
+
+
+def _native_limit(n: int) -> int:
+    """Batch-size ceiling for the native engine at this dispatch.
+
+    NATIVE_MAX when a real accelerator backs jax (commit-sized batches
+    stay native, mega-batches earn the device round trip); past every
+    n when jax is CPU-only. NATIVE_MAX = 0 disables the native engine
+    unconditionally (the test seam for forcing device paths)."""
+    limit = NATIVE_MAX
+    if limit and not _accel_backed():
+        return n + 1
+    return limit
+
 # Minimum batch size for the structured-wire (delta) device path: below
 # this the detection overhead isn't worth it and the native engine has
 # already taken the batch anyway. The upper bucket bound keeps the
@@ -276,7 +309,7 @@ class Ed25519PubKey(PubKey):
         # back to the oracle when no toolchain is available
         from . import native
 
-        crypto_metrics().path_selected_total.inc(1.0, "single")
+        crypto_metrics().path_selected_total.inc(1.0, "single", "ed25519")
         if native.available():
             return native.verify(self._b, msg, sig)
         return ref.verify(self._b, msg, sig)
@@ -440,8 +473,8 @@ class Ed25519BatchVerifier(BatchVerifier):
             dt = _time.perf_counter() - t0
             m = crypto_metrics()
             m.batch_size.observe(self.count())
-            m.path_selected_total.inc(1.0, "cpu")
-            m.verify_seconds.observe(dt, "cpu")
+            m.path_selected_total.inc(1.0, "cpu", "ed25519")
+            m.verify_seconds.observe(dt, "cpu", "ed25519")
             if _trace.enabled:
                 _trace.emit("crypto.batch_verify", "span",
                             dur_ms=round(dt * 1e3, 3), path="cpu",
@@ -465,7 +498,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         pending = None
         path = "ladder"
         if not self._force_perlane:
-            if n < NATIVE_MAX:
+            if n < _native_limit(n):
                 pending = self._native_batch()
                 if pending is not None:
                     path = "native"
@@ -499,7 +532,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         host_s = _time.perf_counter() - t0
         m = crypto_metrics()
         m.batch_size.observe(n)
-        m.path_selected_total.inc(1.0, path)
+        m.path_selected_total.inc(1.0, path, "ed25519")
         pending._path = path
         pending._t0 = t0
         if _trace.enabled:
@@ -834,7 +867,8 @@ def _observe_latency(p) -> None:
         return
     p._t0 = None
     crypto_metrics().verify_seconds.observe(
-        _time.perf_counter() - t0, getattr(p, "_path", None) or "unknown"
+        _time.perf_counter() - t0,
+        getattr(p, "_path", None) or "unknown", "ed25519"
     )
 
 

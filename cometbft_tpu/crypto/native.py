@@ -30,6 +30,8 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "commit_codec.inc"),
     os.path.join(os.path.dirname(_SRC), "sha512_mb.inc"),
     os.path.join(os.path.dirname(_SRC), "rlc_packer.inc"),
+    os.path.join(os.path.dirname(_SRC), "secp256k1.inc"),
+    os.path.join(os.path.dirname(_SRC), "sr25519_native.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -160,6 +162,39 @@ def _bind(lib) -> None:
     ]
     lib.rlc_packer_threads.restype = ctypes.c_int
     lib.rlc_packer_threads.argtypes = []
+    lib.secp256k1_engine.restype = ctypes.c_int
+    lib.secp256k1_engine.argtypes = []
+    lib.secp256k1_verify.restype = ctypes.c_int
+    lib.secp256k1_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.secp256k1_multi_verify.restype = ctypes.c_long
+    lib.secp256k1_multi_verify.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.sr25519_engine.restype = ctypes.c_int
+    lib.sr25519_engine.argtypes = []
+    lib.sr25519_challenge.restype = None
+    lib.sr25519_challenge.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.sr25519_ristretto_decode.restype = ctypes.c_int
+    lib.sr25519_ristretto_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.sr25519_batch_residue.restype = ctypes.c_int
+    lib.sr25519_batch_residue.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.sr25519_batch_verify.restype = ctypes.c_int
+    lib.sr25519_batch_verify.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_char_p,
+    ]
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -484,3 +519,109 @@ def pubkey(seed: bytes) -> bytes:
     out = ctypes.create_string_buffer(32)
     lib.ed25519_pubkey(seed, out)
     return out.raw
+
+
+def secp256k1_available() -> bool:
+    """True when the .so exports the secp256k1 verify engine."""
+    lib = get_lib()
+    return (lib is not None and hasattr(lib, "secp256k1_engine")
+            and bool(lib.secp256k1_engine()))
+
+
+def secp256k1_verify(pub: bytes, msg: bytes, sig: bytes) -> bool | None:
+    """One native ECDSA verify (33-byte SEC1 compressed pub, 64-byte
+    R||S big-endian sig, low-S enforced). None when the lib is absent —
+    caller uses the Python oracle."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "secp256k1_verify"):
+        return None
+    return bool(lib.secp256k1_verify(pub, msg, len(msg), sig))
+
+
+def secp256k1_multi_verify(items, nchunks: int = 0):
+    """Verify [(pub33, msg, sig64), ...] in ONE native call spread over
+    the worker pool (`nchunks` pins the split for determinism tests; 0
+    means pool width). Returns a per-item list of bools, or None when
+    the lib is absent. Unlike the ed25519 batch path there is no
+    all-or-nothing equation — each item is independent, so blame is
+    exact and free."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "secp256k1_multi_verify"):
+        return None
+    n = len(items)
+    if n == 0:
+        return []
+    pubs = b"".join(it[0] for it in items)
+    msgs = b"".join(it[1] for it in items)
+    lens = (ctypes.c_uint64 * n)(*(len(it[1]) for it in items))
+    sigs = b"".join(it[2] for it in items)
+    out = ctypes.create_string_buffer(n)
+    lib.secp256k1_multi_verify(n, pubs, msgs, lens, sigs, nchunks, out)
+    return [b != 0 for b in out.raw]
+
+
+def sr25519_available() -> bool:
+    """True when the .so exports the sr25519 batch unit."""
+    lib = get_lib()
+    return (lib is not None and hasattr(lib, "sr25519_engine")
+            and bool(lib.sr25519_engine()))
+
+
+def sr25519_batch_verify(items, z16: bytes) -> bool | None:
+    """Whole sr25519 batch — ristretto decode + merlin transcripts +
+    mod-L residue + one Pippenger identity check — in ONE native call.
+    `items` is [(pub32, msg, sig64), ...]; `z16` is n*16 bytes of
+    caller randomness (bit 0 of each z forced on inside). False means
+    "batch failed" — caller rescans per-signature for blame, same
+    contract as the Python RLC path. None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sr25519_batch_verify"):
+        return None
+    n = len(items)
+    pubs = b"".join(it[0] for it in items)
+    msgs = b"".join(it[1] for it in items)
+    lens = (ctypes.c_uint64 * max(n, 1))(*(len(it[1]) for it in items))
+    sigs = b"".join(it[2] for it in items)
+    return bool(lib.sr25519_batch_verify(n, pubs, msgs, lens, sigs, z16))
+
+
+def sr25519_batch_residue(ss: bytes, cs: bytes, z16: bytes):
+    """The batch scalar residue alone: per-sig z_i*c_i mod L and the
+    accumulated sum z_i*s_i mod L for n 32-byte LE scalars in `ss`/`cs`
+    and n*16 randomness bytes. Returns (zc_blob, zsum32) or False when
+    some s_i is non-canonical (>= L); None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sr25519_batch_residue"):
+        return None
+    n = len(ss) // 32
+    zc = ctypes.create_string_buffer(n * 32)
+    zsum = ctypes.create_string_buffer(32)
+    if not lib.sr25519_batch_residue(n, ss, cs, z16, zc, zsum):
+        return False
+    return zc.raw, zsum.raw
+
+
+def sr25519_challenge(pub: bytes, msg: bytes, r32: bytes) -> bytes | None:
+    """Merlin "sign:c" challenge scalar (32-byte LE, mod L) for one
+    signature — differential entry against crypto/merlin.py; None when
+    the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sr25519_challenge"):
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.sr25519_challenge(pub, msg, len(msg), r32, out)
+    return out.raw
+
+
+def sr25519_ristretto_decode(enc: bytes):
+    """Native ristretto255 decode: (x int, y int) affine coordinates,
+    False on a rejected encoding, None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sr25519_ristretto_decode"):
+        return None
+    ox = ctypes.create_string_buffer(32)
+    oy = ctypes.create_string_buffer(32)
+    if not lib.sr25519_ristretto_decode(enc, ox, oy):
+        return False
+    return (int.from_bytes(ox.raw, "little"),
+            int.from_bytes(oy.raw, "little"))

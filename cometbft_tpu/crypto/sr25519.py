@@ -67,6 +67,20 @@ def _expand_ed25519(mini: bytes) -> tuple[int, bytes]:
 def _verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
     if len(sig) != SIG_SIZE or not (sig[63] & 0x80):
         return False  # missing schnorrkel v1 marker
+    if len(pub) == PUB_KEY_SIZE:
+        # the native batch entry with n=1 is the exact single-sig check:
+        # z·(s·B − c·A − R) lands in the ristretto identity coset iff
+        # s·B − c·A ristretto-equals R (z odd ⇒ invertible, and the
+        # 4-torsion coset is closed under odd scalars), which is the
+        # encode() comparison below
+        import os as _os
+
+        from . import native
+
+        got = native.sr25519_batch_verify([(pub, msg, sig)],
+                                          _os.urandom(16))
+        if got is not None:
+            return got
     a_pt = R.decode(pub)
     if a_pt is None:
         return False
@@ -237,6 +251,21 @@ def _verify_rlc(items) -> bool:
     with fresh 128-bit z_i. False = some signature is bad (or a point
     failed to decode); the caller re-scans per-signature."""
     import os as _os
+
+    from . import native
+
+    # whole-batch native path: ristretto decode + merlin transcripts +
+    # mod-L residue + the Pippenger identity check in ONE ctypes call
+    # (csrc/sr25519_native.inc). The per-signature Python below — one
+    # sqrt chain per decode, ~8 keccaks of STROBE bookkeeping per
+    # transcript — was the ~200 ms/1000-sig wall PROFILE.md round 5
+    # charged to "sr residue"; it stays as oracle and fallback.
+    if any(len(p) != 32 or len(s) != SIG_SIZE for p, _, s in items):
+        return False  # can't blob columnar; Python loop rejects too
+    got = native.sr25519_batch_verify(
+        items, _os.urandom(16 * len(items)))
+    if got is not None:
+        return got
 
     pairs = []
     zs_sum = 0

@@ -9,6 +9,10 @@
 // Exercises sign, single verify (valid / corrupted / truncated-ish
 // garbage), and the threaded RLC batch with mixed message lengths, so
 // ASAN/UBSAN sees every buffer path including the multi-thread phase.
+// The secp256k1 and sr25519 engine units get the same treatment:
+// embedded known-good vectors for the accept paths, synthesized r/s
+// boundary values, bad point encodings, n==0 batches, identity
+// results, and chunk-count determinism.
 
 #include <cstdint>
 #include <cstdio>
@@ -43,6 +47,20 @@ long rlc_pack(u64 n, u64 bucket, u64 depth, const u8 *pubs, const u8 *sigs,
               u8 *out_neg, u8 *out_counts, int32_t *out_weights, u8 *out_c,
               u64 *out_s_rounds);
 int rlc_packer_threads(void);
+int secp256k1_engine(void);
+int secp256k1_verify(const u8 *pub, const u8 *msg, u64 msg_len,
+                     const u8 *sig);
+long secp256k1_multi_verify(u64 n, const u8 *pubs, const u8 *msgs,
+                            const u64 *msg_lens, const u8 *sigs, int nchunks,
+                            u8 *out_ok);
+int sr25519_engine(void);
+void sr25519_challenge(const u8 *pub, const u8 *msg, u64 msg_len,
+                       const u8 *r32, u8 *out32);
+int sr25519_ristretto_decode(const u8 *in, u8 *out_x, u8 *out_y);
+int sr25519_batch_residue(u64 n, const u8 *ss, const u8 *cs, const u8 *zs,
+                          u8 *out_zc, u8 *out_zsum);
+int sr25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
+                         const u64 *msg_lens, const u8 *sigs, const u8 *zs);
 }
 
 // deterministic PRNG for the fuzz loops (no OS entropy in the harness)
@@ -184,6 +202,231 @@ static int new_surface_checks() {
         }
     }
     printf("asan new-surface checks ok (merkle, batch_k, commit_parse fuzz)\n");
+    return 0;
+}
+
+// -- secp256k1 + sr25519 engine surfaces ----------------------------------
+//
+// Signed host-side (no native signers: RFC 6979 / schnorrkel nonces stay
+// in Python), so the accept paths run over embedded known-good vectors;
+// the reject paths are synthesized in place. Mirrors the differential
+// pytest suite but under ASAN/UBSAN with tightly-sized heap buffers.
+
+static const u8 K1_PUBS[132] = {0x02,0x15,0xdc,0x82,0x89,0xff,0x18,0xff,0x2b,0x69,0x2e,0xbe,0x42,0x3d,0x27,0xf3,0x5a,0x30,0x35,0xf9,0xec,0xf8,0xca,0x7c,0x9c,0xb8,0x2c,0xed,0x5e,0x1e,0x7a,0x31,0x0d,0x03,0x08,0x5e,0xa8,0x1d,0x26,0x20,0x32,0x1e,0x24,0xd7,0xe9,0xe1,0x43,0xe4,0x38,0xfc,0x7b,0x36,0x7a,0x36,0xf2,0x54,0x09,0x09,0xa9,0x69,0x21,0x2e,0x76,0x75,0x33,0xd2,0x03,0x6d,0xdd,0x8a,0x79,0xf3,0xb1,0xa0,0xcd,0xb4,0x5b,0x7c,0x1d,0x1b,0xed,0x7c,0x18,0xc0,0x2c,0xc4,0xd5,0xc3,0x9d,0xaa,0x4b,0x98,0x6e,0x8b,0x66,0x3f,0xcc,0x68,0xb4,0x03,0x66,0x01,0x9e,0x3b,0x00,0xc9,0x24,0xa2,0x46,0xf6,0x0f,0x81,0x43,0x0c,0x4d,0xe2,0x25,0xe4,0x7f,0xfd,0xbc,0x16,0x48,0xaf,0x67,0xd6,0x50,0xd0,0x57,0x12,0xe9,0x23};
+static const u8 K1_SIGS[256] = {0x18,0xac,0xb4,0x9a,0xc9,0x4c,0x1d,0x80,0x5c,0xef,0x8e,0xa1,0xdd,0xf9,0xe0,0x6e,0x40,0xf1,0x2f,0xd7,0x57,0x8b,0x33,0x63,0x69,0xe8,0xf6,0x49,0x7d,0x7a,0x48,0xde,0x73,0x0a,0x6d,0xb0,0xf8,0x3b,0x87,0x34,0x62,0xf5,0xdc,0x41,0xfd,0x80,0x73,0x1d,0x6a,0xdf,0xac,0xf7,0xde,0x15,0xfb,0x83,0x03,0xc1,0x2a,0xdc,0x7f,0x5e,0xca,0x77,0x6a,0x56,0x33,0xe8,0xcd,0x18,0x6f,0x65,0x35,0x07,0x51,0xee,0xd6,0x86,0x38,0xaf,0x72,0x75,0x3e,0xd2,0x1f,0xfa,0x84,0x63,0x1c,0x2b,0xf7,0xf9,0x14,0xba,0x8a,0x7d,0x15,0x52,0x26,0x01,0x60,0xf2,0xf2,0x3f,0xcc,0xea,0x30,0x6d,0xc8,0x72,0x55,0x65,0x8e,0x12,0xe4,0xca,0x4a,0x7c,0x07,0x49,0xda,0x70,0xd8,0xc6,0xd0,0xea,0x51,0x78,0x3e,0xa9,0xc6,0x52,0x6e,0x0e,0xac,0xd3,0x94,0xf0,0xeb,0x2a,0x6f,0xe1,0x90,0x36,0x04,0xef,0x4f,0x8b,0x81,0x41,0xb4,0x4c,0xed,0xd8,0x9a,0x8d,0x9c,0x8f,0xfd,0x6c,0x5e,0x69,0xdc,0x1a,0x97,0x62,0x4c,0x3f,0x86,0x7a,0x46,0xd9,0x1d,0xe1,0x99,0x38,0x31,0x1a,0xb4,0xc8,0x62,0x12,0xd7,0xf4,0x10,0xdb,0xac,0x9b,0xcb,0xc7,0x5a,0xc2,0x54,0x2b,0xd4,0x40,0x36,0x2f,0x5a,0xbe,0xe9,0xf0,0x4f,0xe5,0x71,0x81,0x7d,0x40,0x0d,0xfc,0x9f,0x56,0x20,0x26,0x14,0x69,0xcb,0x2f,0x95,0xc6,0x22,0xf7,0x3a,0x15,0x26,0x93,0xaa,0x49,0xe8,0x23,0x17,0x62,0xd1,0xcb,0x6a,0x02,0xde,0x35,0x83,0x0a,0x0c,0x60,0x9d,0x01,0xb3,0x36,0x65,0x2b,0xb0,0x28,0xe3,0xf8,0x35,0xac,0xf9,0x71};
+static const u8 K1_MSGS[227] = {0x61,0x73,0x61,0x6e,0x20,0x73,0x65,0x63,0x70,0x20,0x76,0x65,0x63,0x74,0x6f,0x72,0x20,0x6f,0x6e,0x65,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x78,0x00,0x01,0x02,0x03,0x04,0x05,0x06,0x07,0x08,0x09,0x0a,0x0b,0x0c,0x0d,0x0e,0x0f,0x10,0x11,0x12,0x13,0x14,0x15,0x16,0x17,0x18,0x19,0x1a,0x1b,0x1c,0x1d,0x1e,0x1f,0x20,0x21,0x22,0x23,0x24,0x25,0x26,0x27,0x28,0x29,0x2a,0x2b,0x2c,0x2d,0x2e,0x2f,0x30,0x31,0x32,0x33,0x34,0x35,0x36,0x37,0x38,0x39,0x3a,0x3b,0x3c,0x3d,0x3e,0x3f,0x40,0x41,0x42,0x43,0x44,0x45,0x46,0x47,0x48,0x49,0x4a,0x4b,0x4c,0x4d,0x4e,0x4f,0x50,0x51,0x52,0x53,0x54,0x55,0x56,0x57,0x58,0x59,0x5a,0x5b,0x5c,0x5d,0x5e,0x5f,0x60,0x61,0x62,0x63,0x64,0x65,0x66,0x67,0x68,0x69,0x6a,0x6b,0x6c,0x6d,0x6e,0x6f,0x70,0x71,0x72,0x73,0x74,0x75,0x76,0x77,0x78,0x79,0x7a,0x7b,0x7c,0x7d,0x7e,0x7f,0x80,0x81};
+static const u64 K1_LENS[4] = {0, 20, 77, 130};
+// secp256k1 group order n, big-endian (the r/s canonicality boundary)
+static const u8 K1_ORDER[32] = {0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,
+                                0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xfe,
+                                0xba,0xae,0xdc,0xe6,0xaf,0x48,0xa0,0x3b,
+                                0xbf,0xd2,0x5e,0x8c,0xd0,0x36,0x41,0x41};
+
+static const u8 SR_PUBS[128] = {0x90,0x98,0x5f,0x87,0x2d,0x70,0xcf,0xe7,0x4b,0x17,0x57,0x3d,0x67,0x9b,0xa1,0x54,0x22,0x70,0x09,0xab,0x6a,0x14,0xa3,0x47,0x52,0x4d,0xd1,0x12,0x5d,0x71,0x4b,0x1b,0xe8,0x61,0x87,0xee,0x7f,0x11,0x29,0x97,0x39,0xd7,0x1a,0x77,0x8d,0xc0,0x26,0x61,0xe1,0x62,0x8a,0xd4,0x5a,0xaa,0x26,0xba,0x54,0x97,0x66,0x3e,0xde,0xc7,0x4f,0x2d,0x74,0x5c,0x17,0x96,0x44,0xcb,0x66,0x6f,0x7b,0x30,0x48,0xb2,0x0d,0x76,0xd2,0x6e,0xf7,0x38,0x56,0xff,0xc5,0x53,0xe5,0xb5,0x12,0x54,0x93,0x4f,0xf0,0xa5,0xa8,0x40,0xf4,0xbc,0xa5,0x59,0xc1,0x8c,0xba,0x51,0xf3,0xa9,0x03,0xc4,0x72,0x87,0x2b,0x7e,0x75,0x16,0x85,0x00,0x29,0xb7,0x50,0x14,0xad,0xbf,0x00,0x69,0x6e,0x4e,0x61,0x72};
+static const u8 SR_SIGS[256] = {0x6e,0xce,0x8d,0x85,0x26,0x2e,0xc1,0xfc,0x47,0x1b,0xb6,0x02,0xd9,0x63,0x98,0x7a,0xd5,0x58,0x05,0xb0,0xa7,0x57,0x10,0x83,0x2b,0x01,0x41,0x0f,0xeb,0xa9,0x6b,0x08,0x79,0x62,0x37,0x83,0xa8,0xc2,0x0d,0xe0,0x51,0x34,0xea,0xf6,0xb7,0x85,0xca,0x19,0x29,0x5c,0x35,0x3e,0x29,0x3e,0x5f,0xe7,0xc1,0xbe,0xd4,0x89,0xd8,0x87,0xe4,0x82,0xcc,0x0c,0x4d,0xac,0xe9,0x25,0xc0,0x90,0x49,0x6c,0x55,0x7c,0x93,0x7c,0x39,0xf3,0x12,0x7c,0x25,0xc1,0xeb,0x17,0x81,0xd0,0xf5,0xd6,0xe7,0x99,0x63,0x6a,0x81,0x67,0x76,0xb3,0xad,0xa6,0x3c,0xb2,0xef,0x93,0x00,0xc6,0x82,0xa8,0x04,0x67,0x1e,0xfa,0x4b,0xcf,0x67,0x52,0x18,0xab,0xa6,0x35,0x28,0x05,0xf6,0xeb,0xe4,0x4b,0xa0,0x87,0xa2,0x4e,0x32,0xdb,0x84,0x42,0x89,0x66,0x21,0x92,0x6e,0xd6,0x12,0x55,0xbd,0x56,0xa4,0x85,0xe4,0xb8,0xb3,0x81,0x64,0x46,0x7d,0x7c,0x1e,0xdc,0x7b,0x16,0x13,0x12,0x88,0x0b,0xbd,0x76,0xba,0x8d,0xae,0x92,0xdb,0x9a,0xc2,0xdc,0x5f,0x2e,0x01,0x58,0xf4,0x4d,0x2a,0xca,0x20,0x9b,0x01,0x0e,0x6e,0x0e,0x4b,0xf8,0x6d,0x94,0xa3,0x81,0x46,0x70,0x65,0xa7,0x9f,0xfd,0xcc,0x2f,0xe0,0x2a,0x9e,0xc9,0x16,0x43,0xb3,0x09,0xb0,0x47,0xaa,0xba,0xe7,0x64,0x4e,0x24,0x66,0xbf,0x83,0xc1,0x31,0x6d,0x60,0x1f,0x61,0x0e,0xb7,0xc6,0x9f,0x03,0xee,0xf5,0x4b,0x9e,0x28,0x94,0xdb,0x9b,0xb4,0xf1,0x6d,0xde,0x59,0x16,0x05,0xae,0xd1,0x3e,0xfc,0x09,0xdd,0x66,0x09,0xde,0x9d,0x8b};
+static const u8 SR_MSGS[159] = {0x61,0x73,0x61,0x6e,0x20,0x73,0x72,0x20,0x76,0x65,0x63,0x74,0x6f,0x72,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x79,0x00,0x01,0x02,0x03,0x04,0x05,0x06,0x07,0x08,0x09,0x0a,0x0b,0x0c,0x0d,0x0e,0x0f,0x10,0x11,0x12,0x13,0x14,0x15,0x16,0x17,0x18,0x19,0x1a,0x1b,0x1c,0x1d,0x1e,0x1f,0x20,0x21,0x22,0x23,0x24,0x25,0x26,0x27,0x28,0x29,0x2a,0x2b,0x2c,0x2d,0x2e,0x2f,0x30,0x31,0x32,0x33,0x34,0x35,0x36,0x37,0x38,0x39,0x3a,0x3b,0x3c,0x3d,0x3e,0x3f,0x40,0x41,0x42,0x43,0x44,0x45,0x46,0x47,0x48,0x49,0x4a,0x4b,0x4c,0x4d,0x4e,0x4f,0x50,0x51,0x52,0x53,0x54,0x55,0x56,0x57,0x58,0x59};
+static const u64 SR_LENS[4] = {0, 14, 55, 90};
+// ed25519 group order L, little-endian (the sr scalar canonicality bound)
+static const u8 SR_ORDER_LE[32] = {0xed,0xd3,0xf5,0x5c,0x1a,0x63,0x12,0x58,
+                                   0xd6,0x9c,0xf7,0xa2,0xde,0xf9,0xde,0x14,
+                                   0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,
+                                   0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x10};
+
+static int secp256k1_checks() {
+    if (secp256k1_engine() < 1) {
+        printf("FAIL: secp256k1_engine < 1\n");
+        return 1;
+    }
+    // accept path: every embedded vector verifies singly
+    const u8 *msg = K1_MSGS;
+    for (int i = 0; i < 4; i++) {
+        if (!secp256k1_verify(K1_PUBS + i * 33, msg, K1_LENS[i],
+                              K1_SIGS + i * 64)) {
+            printf("FAIL: secp vector %d rejected\n", i);
+            return 1;
+        }
+        msg += K1_LENS[i];
+    }
+    // r/s boundary cases on vector 1: r=0, s=0, s=n (order itself),
+    // s=n-1 (>= n/2: upper-half malleability), all must reject without
+    // touching out-of-range limbs
+    u8 sig[64];
+    const u8 *m1 = K1_MSGS + K1_LENS[0];
+    const struct { int off; const u8 *src; } edges[] = {
+        {0, nullptr},            // r = 0
+        {32, nullptr},           // s = 0
+        {32, K1_ORDER},          // s = n (non-canonical)
+    };
+    for (auto &e : edges) {
+        memcpy(sig, K1_SIGS + 64, 64);
+        if (e.src) memcpy(sig + e.off, e.src, 32);
+        else       memset(sig + e.off, 0, 32);
+        if (secp256k1_verify(K1_PUBS + 33, m1, K1_LENS[1], sig)) {
+            printf("FAIL: secp r/s edge accepted (off %d)\n", e.off);
+            return 1;
+        }
+    }
+    memcpy(sig, K1_SIGS + 64, 64);
+    memcpy(sig + 32, K1_ORDER, 32);
+    sig[63] -= 1;  // s = n-1: canonical range but upper half -> reject
+    if (secp256k1_verify(K1_PUBS + 33, m1, K1_LENS[1], sig)) {
+        printf("FAIL: secp high-s accepted\n");
+        return 1;
+    }
+    // invalid point encodings: bad parity byte, x >= p, identity-ish
+    // all-zero key; each must reject cleanly
+    u8 pub[33];
+    memcpy(pub, K1_PUBS + 33, 33);
+    pub[0] = 0x04;  // not a compressed-form prefix
+    if (secp256k1_verify(pub, m1, K1_LENS[1], K1_SIGS + 64)) {
+        printf("FAIL: secp bad parity byte accepted\n");
+        return 1;
+    }
+    memset(pub, 0xff, 33); pub[0] = 0x02;  // x >= p
+    u8 zpub[33]; memset(zpub, 0, 33); zpub[0] = 0x02;  // x=0: not on curve
+    if (secp256k1_verify(pub, m1, K1_LENS[1], K1_SIGS + 64) ||
+        secp256k1_verify(zpub, m1, K1_LENS[1], K1_SIGS + 64)) {
+        printf("FAIL: secp invalid point accepted\n");
+        return 1;
+    }
+    // multi-verify: n==0 returns 0; mixed batch (one corrupted) returns
+    // the same bitmap for every chunk count
+    if (secp256k1_multi_verify(0, nullptr, nullptr, nullptr, nullptr, 0,
+                               nullptr) != 0) {
+        printf("FAIL: secp multi(0) != 0\n");
+        return 1;
+    }
+    std::vector<u8> sigs(K1_SIGS, K1_SIGS + 256);
+    sigs[2 * 64 + 7] ^= 1;  // corrupt vector 2
+    u8 ref[4];
+    long nref = secp256k1_multi_verify(4, K1_PUBS, K1_MSGS, K1_LENS,
+                                       sigs.data(), 1, ref);
+    if (nref != 3 || !ref[0] || !ref[1] || ref[2] || !ref[3]) {
+        printf("FAIL: secp multi bitmap wrong\n");
+        return 1;
+    }
+    for (int nc : {0, 2, 3, 7}) {
+        u8 got[4];
+        long nv = secp256k1_multi_verify(4, K1_PUBS, K1_MSGS, K1_LENS,
+                                         sigs.data(), nc, got);
+        if (nv != nref || memcmp(got, ref, 4) != 0) {
+            printf("FAIL: secp multi not chunk-deterministic (nc=%d)\n", nc);
+            return 1;
+        }
+    }
+    printf("asan secp256k1 checks ok (vectors, r/s edges, bad points, "
+           "chunk determinism)\n");
+    return 0;
+}
+
+static int sr25519_checks() {
+    if (sr25519_engine() < 1) {
+        printf("FAIL: sr25519_engine < 1\n");
+        return 1;
+    }
+    // ristretto decode: valid pubkeys round through; the identity
+    // (all-zero) encoding decodes to (0, 1); negated/noncanonical reject
+    u8 x[32], y[32];
+    for (int i = 0; i < 4; i++) {
+        if (!sr25519_ristretto_decode(SR_PUBS + i * 32, x, y)) {
+            printf("FAIL: sr pubkey %d undecodable\n", i);
+            return 1;
+        }
+    }
+    u8 ident[32]; memset(ident, 0, 32);
+    if (!sr25519_ristretto_decode(ident, x, y)) {
+        printf("FAIL: sr identity encoding rejected\n");
+        return 1;
+    }
+    u8 one[32]; memset(one, 0, 32); one[0] = 1;
+    for (int b = 0; b < 32; b++) {
+        if (x[b] != 0 || y[b] != one[b]) {
+            printf("FAIL: sr identity != (0,1)\n");
+            return 1;
+        }
+    }
+    u8 bad[32];
+    memcpy(bad, SR_PUBS, 32); bad[0] ^= 1;  // negative field element
+    u8 ff[32]; memset(ff, 0xff, 32);        // non-canonical (>= p)
+    if (sr25519_ristretto_decode(bad, x, y) ||
+        sr25519_ristretto_decode(ff, x, y)) {
+        printf("FAIL: sr invalid encoding accepted\n");
+        return 1;
+    }
+    // challenge: deterministic (same transcript twice -> same scalar)
+    u8 c1[32], c2[32];
+    sr25519_challenge(SR_PUBS, SR_MSGS, 14, SR_SIGS, c1);
+    sr25519_challenge(SR_PUBS, SR_MSGS, 14, SR_SIGS, c2);
+    if (memcmp(c1, c2, 32) != 0) {
+        printf("FAIL: sr challenge not deterministic\n");
+        return 1;
+    }
+    // batch residue: n==0 is the empty sum (zsum = 0); zero scalars
+    // give identity results (z*0 = 0 even though z itself is forced
+    // odd); s >= L rejects
+    u8 zsum[32];
+    if (sr25519_batch_residue(0, nullptr, nullptr, nullptr, nullptr,
+                              zsum) != 1) {
+        printf("FAIL: sr residue(0) != 1\n");
+        return 1;
+    }
+    for (int b = 0; b < 32; b++)
+        if (zsum[b]) { printf("FAIL: sr residue(0) zsum != 0\n"); return 1; }
+    u8 ss[3 * 32], cs[3 * 32], zs[3 * 16], zc[3 * 32];
+    memset(ss, 0, sizeof ss);             // s=0, c=0: identity residues
+    memset(cs, 0, sizeof cs);
+    for (auto &b : zs) b = lcg();
+    if (sr25519_batch_residue(3, ss, cs, zs, zc, zsum) != 1) {
+        printf("FAIL: sr residue rejected canonical batch\n");
+        return 1;
+    }
+    for (int b = 0; b < 3 * 32; b++)
+        if (zc[b]) { printf("FAIL: sr residue c=0 not identity\n"); return 1; }
+    for (int b = 0; b < 32; b++)
+        if (zsum[b]) { printf("FAIL: sr residue s=0 zsum != 0\n"); return 1; }
+    for (auto &b : cs) b = lcg() & 0x0f;  // small => canonical scalars
+    memcpy(ss + 32, SR_ORDER_LE, 32);     // s_1 = L: non-canonical
+    if (sr25519_batch_residue(3, ss, cs, zs, zc, zsum) != 0) {
+        printf("FAIL: sr residue accepted s >= L\n");
+        return 1;
+    }
+    // batch verify: n==0 vacuously valid; embedded vectors accept under
+    // two different z draws; one flipped bit (and a cleared marker)
+    // fails the whole batch
+    if (sr25519_batch_verify(0, nullptr, nullptr, nullptr, nullptr,
+                             nullptr) != 1) {
+        printf("FAIL: sr batch(0) != 1\n");
+        return 1;
+    }
+    u8 z4[4 * 16];
+    for (auto &b : z4) b = lcg();
+    if (sr25519_batch_verify(4, SR_PUBS, SR_MSGS, SR_LENS, SR_SIGS,
+                             z4) != 1) {
+        printf("FAIL: sr valid batch rejected\n");
+        return 1;
+    }
+    for (auto &b : z4) b = lcg();  // different randomizers, same verdict
+    if (sr25519_batch_verify(4, SR_PUBS, SR_MSGS, SR_LENS, SR_SIGS,
+                             z4) != 1) {
+        printf("FAIL: sr valid batch rejected (z draw 2)\n");
+        return 1;
+    }
+    std::vector<u8> sigs(SR_SIGS, SR_SIGS + 256);
+    sigs[1 * 64 + 9] ^= 4;
+    if (sr25519_batch_verify(4, SR_PUBS, SR_MSGS, SR_LENS, sigs.data(),
+                             z4) != 0) {
+        printf("FAIL: sr corrupted batch accepted\n");
+        return 1;
+    }
+    sigs.assign(SR_SIGS, SR_SIGS + 256);
+    sigs[3 * 64 + 63] &= 0x7f;  // schnorrkel marker bit cleared
+    if (sr25519_batch_verify(4, SR_PUBS, SR_MSGS, SR_LENS, sigs.data(),
+                             z4) != 0) {
+        printf("FAIL: sr marker-less sig accepted\n");
+        return 1;
+    }
+    printf("asan sr25519 checks ok (ristretto, challenge, residue, "
+           "batch verify)\n");
     return 0;
 }
 
@@ -336,6 +579,8 @@ int main() {
     }
     if (new_surface_checks() != 0) return 1;
     if (rlc_packer_checks() != 0) return 1;
+    if (secp256k1_checks() != 0) return 1;
+    if (sr25519_checks() != 0) return 1;
     printf("asan selftest ok (%d signatures, threaded batch)\n", N);
     return 0;
 }

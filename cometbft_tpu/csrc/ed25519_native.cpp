@@ -1340,3 +1340,13 @@ void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
 
 // Columnar Commit wire parser (own extern "C" exports)
 #include "commit_codec.inc"
+
+// secp256k1 ECDSA verify engine — 5x52 field, wNAF Strauss–Shamir
+// (own extern "C" exports: secp256k1_verify, secp256k1_multi_verify;
+// uses sha256_oneshot from merkle_native.inc, pool from rlc_packer.inc)
+#include "secp256k1.inc"
+
+// sr25519 batch verification — merlin/STROBE transcripts, ristretto
+// decode, mod-L residue (own extern "C" exports; uses the fe/sc/ge
+// cores, keccak_f1600 and edwards_msm_is_identity from this TU)
+#include "sr25519_native.inc"

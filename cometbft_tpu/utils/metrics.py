@@ -329,10 +329,12 @@ class CryptoMetrics:
         self.path_selected_total = reg.counter(
             "crypto", "path_selected_total",
             "Dispatch decisions per verify path "
-            "(native/rlc/ladder/delta/cpu)", labels=("path",))
+            "(native/rlc/ladder/delta/cpu) and curve",
+            labels=("path", "curve"))
         self.verify_seconds = reg.histogram(
             "crypto", "verify_seconds",
-            "Batch-verify wall time submit→result", labels=("path",))
+            "Batch-verify wall time submit→result",
+            labels=("path", "curve"))
         self.calibration_us_per_sig = reg.gauge(
             "crypto", "calibration_us_per_sig",
             "Calibrated host-stage dispatch terms", labels=("term",))

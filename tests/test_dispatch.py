@@ -80,6 +80,45 @@ def test_native_batch_verify_direct():
     assert not native.batch_verify(items)
 
 
+def test_native_limit_tracks_accelerator_presence(monkeypatch):
+    """With a real accelerator, NATIVE_MAX caps the native engine and
+    mega-batches earn the device round trip; on CPU-only jax the
+    "device" is this same host emulating the graph, so every size
+    stays native. NATIVE_MAX = 0 force-disables native either way (the
+    seam the device-path tests use)."""
+    from cometbft_tpu.crypto import ed25519 as e
+
+    monkeypatch.setattr(e, "_ACCEL_BACKED", True)
+    assert e._native_limit(5000) == e.NATIVE_MAX
+    assert e._native_limit(100) == e.NATIVE_MAX
+    monkeypatch.setattr(e, "_ACCEL_BACKED", False)
+    assert e._native_limit(5000) == 5001
+    monkeypatch.setattr(e, "NATIVE_MAX", 0)
+    assert e._native_limit(5000) == 0
+    monkeypatch.setattr(e, "_ACCEL_BACKED", True)
+    assert e._native_limit(5000) == 0
+
+
+@needs_native
+def test_no_accel_keeps_mega_batches_native(monkeypatch):
+    """A batch past NATIVE_MAX must still route to the native engine
+    when no accelerator backs jax — the emulated device paths lose by
+    orders of magnitude and their mega-shape XLA compiles take
+    minutes."""
+    from cometbft_tpu.crypto import ed25519 as e
+
+    monkeypatch.setattr(e, "_ACCEL_BACKED", False)
+    n = e.NATIVE_MAX + 40
+    items = _signed(n, msg_len=40)
+    bv = Ed25519BatchVerifier(backend="tpu")
+    for p, m, s in items:
+        bv.add(Ed25519PubKey(p), m, s)
+    pending = bv.submit()
+    assert isinstance(pending, DonePending), "mega batch must stay native"
+    ok, bits = pending.result()
+    assert ok and all(bits) and len(bits) == n
+
+
 def test_expand_stream_device_matches_host():
     """The on-device stream expansion must reproduce the host reference
     expansion exactly (cheap jit; the full MSM e2e below is TPU-only

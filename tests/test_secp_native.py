@@ -1,0 +1,161 @@
+"""Native secp256k1 verify engine (csrc/secp256k1.inc) vs the pure
+Python ECDSA oracle (crypto/secp256k1.verify_python): the two must
+agree bit-for-bit on accept AND reject for every input class — valid
+signatures, bit-flip mutations, r/s boundary values (0, n, n+1,
+upper-half S), malformed point encodings, and random garbage. The
+multi-verify entry is additionally pinned chunk-count deterministic
+(the worker-pool contract), and the dispatch is proven both ways:
+native present routes native, native absent still verifies via the
+oracle."""
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import native, secp256k1 as K
+
+pytestmark = pytest.mark.skipif(
+    not native.secp256k1_available(), reason="no native secp256k1 engine"
+)
+
+rng = random.Random(0x5EC9)
+
+
+def _vec(seed: bytes, msg_len: int):
+    sk = K.Secp256k1PrivKey.from_secret(seed)
+    msg = rng.randbytes(msg_len)
+    return sk.pub_key().bytes(), msg, sk.sign(msg)
+
+
+def _both(pub, msg, sig):
+    """(native verdict, oracle verdict) — the pair every test compares."""
+    return bool(native.secp256k1_verify(pub, msg, sig)), K.verify_python(
+        pub, msg, sig
+    )
+
+
+def test_valid_signatures_accept():
+    for i in range(24):
+        pub, msg, sig = _vec(bytes([i]) * 32, i * 9 % 151)
+        got, want = _both(pub, msg, sig)
+        assert got and want, i
+
+
+def test_mutation_fuzz_agrees():
+    # every single-bit signature corruption must produce the SAME
+    # verdict from both engines (almost always reject; the assert is
+    # on agreement, not on the verdict)
+    for i in range(12):
+        pub, msg, sig = _vec(bytes([i + 50]) * 32, 40)
+        for _ in range(8):
+            m = bytearray(sig)
+            m[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            got, want = _both(pub, msg, bytes(m))
+            assert got == want, (i, bytes(m).hex())
+        # wrong message rejects on both
+        got, want = _both(pub, msg + b"!", sig)
+        assert got == want is False
+
+
+def test_rs_boundary_values():
+    pub, msg, sig = _vec(b"\x01" * 32, 17)
+    s_int = int.from_bytes(sig[32:], "big")
+    cases = [
+        sig[:32] + (K.N - s_int).to_bytes(32, "big"),  # upper-half S
+        bytes(32) + sig[32:],                          # r = 0
+        sig[:32] + bytes(32),                          # s = 0
+        K.N.to_bytes(32, "big") + sig[32:],            # r = n
+        sig[:32] + K.N.to_bytes(32, "big"),            # s = n
+        (K.N + 1).to_bytes(32, "big") + sig[32:],      # r non-canonical
+        sig[:32] + (K.N + 1).to_bytes(32, "big"),      # s non-canonical
+        (2**256 - 1).to_bytes(32, "big") + sig[32:],   # r max
+    ]
+    for t in cases:
+        got, want = _both(pub, msg, t)
+        assert got == want is False, t.hex()
+
+
+def test_malleated_high_s_rejected_everywhere():
+    # the verify equation holds for (r, n-s) — only the low-S rule
+    # rejects it, so this pins the malleability check specifically
+    for i in range(6):
+        pub, msg, sig = _vec(bytes([i + 7]) * 32, 33)
+        s_int = int.from_bytes(sig[32:], "big")
+        high = sig[:32] + (K.N - s_int).to_bytes(32, "big")
+        got, want = _both(pub, msg, high)
+        assert got == want is False, i
+        verdicts = K.verify_many([(pub, msg, high)])
+        assert verdicts == [False]
+
+
+def test_bad_point_encodings():
+    pub, msg, sig = _vec(b"\x02" * 32, 21)
+    wrong_parity = bytes([5 - pub[0]]) + pub[1:]   # 2 <-> 3
+    bad_prefix = bytes([0x04]) + pub[1:]           # uncompressed marker
+    x_too_big = bytes([0x02]) + b"\xff" * 32       # x >= p
+    off_curve = bytes([0x02]) + bytes(32)          # x=0: 7 is not a QR
+    for bp in (wrong_parity, bad_prefix, x_too_big, off_curve):
+        got, want = _both(bp, msg, sig)
+        assert got == want, bp.hex()
+    # wrong-parity key is a VALID point — sig must still reject
+    assert _both(wrong_parity, msg, sig) == (False, False)
+
+
+def test_truncated_and_oversized_sigs():
+    pub, msg, sig = _vec(b"\x03" * 32, 10)
+    for bad in (sig[:63], sig[:32], b"", sig + b"\x00"):
+        # length guard lives above the native boundary: both the
+        # method and the oracle reject without calling into C
+        assert not K.Secp256k1PubKey(pub).verify_signature(msg, bad)
+        assert not K.verify_python(pub, msg, bad)
+    verdicts = K.verify_many(
+        [(pub, msg, sig[:63]), (pub, msg, sig), (pub[:32], msg, sig)]
+    )
+    assert verdicts == [False, True, False]
+
+
+def test_garbage_fuzz_agrees():
+    for _ in range(150):
+        pub = rng.randbytes(33)
+        msg = rng.randbytes(rng.randrange(0, 64))
+        sig = rng.randbytes(64)
+        got, want = _both(pub, msg, sig)
+        assert got == want, (pub.hex(), sig.hex())
+
+
+def test_multi_verify_bitmap_and_chunk_determinism():
+    n = 37
+    items = [_vec(bytes([i]) * 32, i % 17) for i in range(n)]
+    expect = [True] * n
+    for j in (4, 11, 30):
+        pub, msg, sig = items[j]
+        items[j] = (pub, msg, sig[:10] + bytes([sig[10] ^ 1]) + sig[11:])
+        expect[j] = False
+    outs = [K.verify_many(items, nchunks=nc) for nc in (0, 1, 3, 8)]
+    for o in outs:
+        assert o == expect
+    assert K.verify_many([]) == []
+    # the oracle agrees with the bitmap element-wise
+    assert [K.verify_python(*it) for it in items] == expect
+
+
+def test_dispatch_native_route_taken(monkeypatch):
+    # poison the oracle: if verify_signature still succeeds, the
+    # native path carried it
+    pub, msg, sig = _vec(b"\x0a" * 32, 25)
+    monkeypatch.setattr(
+        K, "verify_python", lambda *a: pytest.fail("oracle called")
+    )
+    assert K.Secp256k1PubKey(pub).verify_signature(msg, sig)
+    assert K.verify_many([(pub, msg, sig)]) == [True]
+
+
+def test_dispatch_fallback_route_verifies(monkeypatch):
+    # native absent -> the Python oracle still accepts valid and
+    # rejects corrupt, so a toolchain-less host keeps consensus
+    pub, msg, sig = _vec(b"\x0b" * 32, 25)
+    monkeypatch.setattr(K._native, "secp256k1_available", lambda: False)
+    assert K.Secp256k1PubKey(pub).verify_signature(msg, sig)
+    bad = sig[:20] + bytes([sig[20] ^ 1]) + sig[21:]
+    assert not K.Secp256k1PubKey(pub).verify_signature(msg, bad)
+    assert K.verify_many([(pub, msg, sig), (pub, msg, bad)]) == [True, False]

@@ -1,0 +1,199 @@
+"""Native sr25519 batch unit (csrc/sr25519_native.inc) vs the Python
+oracles: ristretto decode against crypto/ristretto.decode, the merlin
+"sign:c" challenge against crypto/merlin.Transcript, the batch scalar
+residue against bigint arithmetic mod L, and the full batch verify
+against the schnorrkel equation — accept AND reject must agree on
+every input class. Dispatch is pinned both ways like the secp suite:
+native present carries the batch, native absent still verifies via
+the Python RLC path."""
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import native, ristretto as R, sr25519 as SR
+from cometbft_tpu.crypto.sr25519 import (
+    Sr25519PrivKey,
+    Sr25519PubKey,
+    _challenge_scalar,
+    _signing_context_transcript,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.sr25519_available(), reason="no native sr25519 unit"
+)
+
+rng = random.Random(0x5251)
+
+L = SR.L
+
+
+def _vec(seed: bytes, msg_len: int):
+    sk = Sr25519PrivKey.from_secret(seed)
+    msg = rng.randbytes(msg_len)
+    return sk.pub_key().bytes(), msg, sk.sign(msg)
+
+
+def _z(n):
+    return rng.randbytes(16 * n)
+
+
+def test_ristretto_decode_valid_points():
+    for i in range(24):
+        enc = Sr25519PrivKey.from_secret(bytes([i]) * 32).pub_key().bytes()
+        want = R.decode(enc)
+        got = native.sr25519_ristretto_decode(enc)
+        assert got is not False and got is not None
+        assert got == (want[0] % R.P, want[1] % R.P), i
+
+
+def test_ristretto_decode_fuzz_agrees():
+    rejects = 0
+    for _ in range(300):
+        enc = rng.randbytes(32)
+        want = R.decode(enc) is not None
+        got = native.sr25519_ristretto_decode(enc)
+        assert (got is not False) == want, enc.hex()
+        rejects += not want
+    assert rejects > 250  # random strings almost never decode
+
+
+def test_ristretto_decode_edge_encodings():
+    # identity (all-zero) is a valid encoding -> (0, 1); negative
+    # field elements (lsb set) and non-canonical (>= p) reject
+    assert native.sr25519_ristretto_decode(bytes(32)) == (0, 1)
+    assert R.decode(bytes(32)) is not None
+    for bad in (b"\x01" + bytes(31), b"\xff" * 32,
+                R.P.to_bytes(32, "little")):
+        assert native.sr25519_ristretto_decode(bad) is False
+        assert R.decode(bad) is None
+
+
+def test_challenge_differential():
+    for i in range(20):
+        pub = Sr25519PrivKey.from_secret(bytes([i + 1]) * 32).pub_key().bytes()
+        msg = rng.randbytes(i * 7)
+        r32 = rng.randbytes(32)
+        t = _signing_context_transcript(msg)
+        t.append_message(b"proto-name", b"Schnorr-sig")
+        t.append_message(b"sign:pk", pub)
+        t.append_message(b"sign:R", r32)
+        want = _challenge_scalar(t, b"sign:c")
+        got = native.sr25519_challenge(pub, msg, r32)
+        assert int.from_bytes(got, "little") == want, i
+
+
+def test_batch_residue_differential():
+    n = 9
+    ss = [rng.randrange(L) for _ in range(n)]
+    cs = [rng.randrange(L) for _ in range(n)]
+    zs = [rng.randbytes(16) for _ in range(n)]
+    out = native.sr25519_batch_residue(
+        b"".join(s.to_bytes(32, "little") for s in ss),
+        b"".join(c.to_bytes(32, "little") for c in cs),
+        b"".join(zs),
+    )
+    assert out is not False and out is not None
+    zc_blob, zsum = out
+    acc = 0
+    for i in range(n):
+        z = int.from_bytes(zs[i], "little") | 1
+        assert (
+            int.from_bytes(zc_blob[32 * i : 32 * i + 32], "little")
+            == z * cs[i] % L
+        ), i
+        acc = (acc + z * ss[i]) % L
+    assert int.from_bytes(zsum, "little") == acc
+
+
+def test_batch_residue_rejects_noncanonical_s():
+    zs = _z(3)
+    cs = b"".join(rng.randrange(L).to_bytes(32, "little") for _ in range(3))
+    for bad_s in (L, L + 7, 2**256 - 1):
+        ss = (
+            (5).to_bytes(32, "little")
+            + bad_s.to_bytes(32, "little")
+            + (9).to_bytes(32, "little")
+        )
+        assert native.sr25519_batch_residue(ss, cs, zs) is False
+
+
+def test_batch_verify_accepts_valid():
+    items = [_vec(bytes([i + 3]) * 32, i % 19) for i in range(25)]
+    # two independent randomizer draws: the verdict must not depend
+    # on z (soundness error is ~2^-128 per draw)
+    assert native.sr25519_batch_verify(items, _z(25)) is True
+    assert native.sr25519_batch_verify(items, _z(25)) is True
+    assert native.sr25519_batch_verify([], b"") is True
+
+
+def test_batch_verify_rejects_corruption():
+    items = [_vec(bytes([i + 40]) * 32, 30) for i in range(8)]
+    for mut in range(4):
+        bad = list(items)
+        pub, msg, sig = bad[mut * 2]
+        m = bytearray(sig)
+        m[rng.randrange(63)] ^= 1 << rng.randrange(8)
+        bad[mut * 2] = (pub, msg, bytes(m))
+        assert native.sr25519_batch_verify(bad, _z(8)) is False
+    # schnorrkel v1 marker cleared
+    bad = list(items)
+    pub, msg, sig = bad[3]
+    bad[3] = (pub, msg, sig[:63] + bytes([sig[63] & 0x7F]))
+    assert native.sr25519_batch_verify(bad, _z(8)) is False
+    # undecodable pubkey
+    bad = list(items)
+    _, msg, sig = bad[5]
+    bad[5] = (b"\xff" * 32, msg, sig)
+    assert native.sr25519_batch_verify(bad, _z(8)) is False
+
+
+def test_single_verify_agrees_with_python(monkeypatch):
+    # _verify_one routes n=1 through the native batch; the Python
+    # equation below it is the oracle — both verdicts for valid,
+    # mutated, and cross-key signatures must match
+    vecs = [_vec(bytes([i + 70]) * 32, 12 + i) for i in range(6)]
+
+    def python_only(pub, msg, sig):
+        with monkeypatch.context() as mctx:
+            mctx.setattr(native, "sr25519_batch_verify", lambda *a: None)
+            return SR._verify_one(pub, msg, sig)
+
+    for i, (pub, msg, sig) in enumerate(vecs):
+        assert SR._verify_one(pub, msg, sig) is True
+        assert python_only(pub, msg, sig) is True
+        m = bytearray(sig)
+        m[rng.randrange(64)] ^= 1 << rng.randrange(7)
+        assert SR._verify_one(pub, msg, bytes(m)) == python_only(
+            pub, msg, bytes(m)
+        ), i
+        other_pub = vecs[(i + 1) % 6][0]
+        assert SR._verify_one(other_pub, msg, sig) is False
+        assert python_only(other_pub, msg, sig) is False
+
+
+def test_dispatch_fallback_route_verifies(monkeypatch):
+    # native absent -> the Python RLC path still accepts valid batches
+    # and rejects corrupt ones
+    items = [_vec(bytes([i + 90]) * 32, 20) for i in range(5)]
+    monkeypatch.setattr(native, "sr25519_batch_verify", lambda *a: None)
+    assert SR._verify_rlc(items) is True
+    pub, msg, sig = items[2]
+    items[2] = (pub, msg, sig[:8] + bytes([sig[8] ^ 2]) + sig[9:])
+    assert SR._verify_rlc(items) is False
+    pub, msg, sig = items[2]
+    assert Sr25519PubKey(pub).verify_signature(msg, sig) is False
+
+
+def test_dispatch_native_route_taken(monkeypatch):
+    # poison the Python MSM below the native call: if _verify_rlc still
+    # returns, the native batch carried it
+    items = [_vec(bytes([i + 110]) * 32, 20) for i in range(4)]
+    monkeypatch.setattr(
+        SR, "_msm", lambda *a: pytest.fail("python MSM called")
+    )
+    monkeypatch.setattr(
+        native, "edwards_msm_is_identity",
+        lambda *a: pytest.fail("msm fallback called"),
+    )
+    assert SR._verify_rlc(items) is True

@@ -3,7 +3,11 @@
 # sanitizer leg for csrc; the Python suite covers the logic, this
 # catches memory errors the .so build would hide). Covers the RLC
 # packer entry points (rlc_pack / rlc_packer_threads) with tight
-# buffers: n==0, all-skip, max-bucket, and chunk-determinism shapes.
+# buffers: n==0, all-skip, max-bucket, and chunk-determinism shapes —
+# plus the secp256k1 verify engine (r/s boundary values, bad point
+# encodings, multi-verify chunk determinism) and the sr25519 unit
+# (ristretto decode rejects, merlin challenge, batch residue s >= L,
+# n==0 batches).
 set -e
 cd "$(dirname "$0")/.."
 # -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults
