@@ -96,6 +96,15 @@ def cmd_start(args) -> int:
     node = Node(cfg, app=app)
     node.start()
     print(f"node started: p2p {node.listen_addr}, rpc {getattr(node, 'rpc_addr', None)}")
+    # SIGTERM (the e2e runner's and any supervisor's stop signal) takes
+    # the same graceful path as ^C: stores close and the buffered trace
+    # sink flushes instead of dying mid-write
+    import signal as _signal
+
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _term)
     try:
         while True:
             time.sleep(1)

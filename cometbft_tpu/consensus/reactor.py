@@ -37,6 +37,7 @@ from ..types import Proposal, Vote
 from ..types.basic import BlockID, PartSetHeader
 from ..types.part_set import PART_SIZE, Part, PartSet
 from ..types.vote import SignedMsgType
+from ..utils import trace
 from ..utils.log import logger
 from ..utils.metrics import p2p_metrics
 from .state import ConsensusState, ProposalMessage, RoundStep, VoteMessage
@@ -286,6 +287,83 @@ def decode_consensus_msg(buf: bytes):
 
 
 # ----------------------------------------------------------------------
+# flight-recorder wire hook (ISSUE 6): classify consensus wire messages
+# into p2p.send / p2p.recv trace records WITHOUT constructing
+# Vote/Proposal objects — only the outer tag and the height/round (and
+# vote-type / index) varints are peeked. Installed on the switch via
+# set_msg_tracer so the p2p layer stays ignorant of the wire format;
+# the traceview merger pairs these records across per-node sinks to
+# align clocks and build message edges.
+# ----------------------------------------------------------------------
+# HasVote (tag 5) is deliberately absent: it is the chattiest frame on
+# the state channel (every vote received is re-announced to every
+# peer), carries no payload the analyzers use, and tracing it measurably
+# inflates sink volume on dense vote gossip.
+_WIRE_MSG_KINDS = {
+    1: "vote", 2: "proposal", 3: "block_bytes", 4: "new_round_step",
+    6: "block_part", 7: "vote_set_maj23",
+    8: "vote_set_bits", 9: "new_valid_block",
+}
+_VOTE_TYPE_NAMES = {1: "prevote", 2: "precommit", 32: "proposal"}
+_TRACE_CHANNELS = frozenset((STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL))
+
+
+def peek_wire_msg(raw: bytes) -> dict | None:
+    """Cheap metadata peek of an encoded consensus wire message:
+    {"msg": kind, "height": h, "round": r, [+ "type"/"idx"/"step"]}.
+    Returns None for unknown tags."""
+    fields = pb.parse_fields(raw)
+    if not fields:
+        return None
+    tag, _, v = fields[0]
+    kind = _WIRE_MSG_KINDS.get(tag)
+    if kind is None:
+        return None
+    emb = pb.fields_to_dict(pb.as_bytes(v))
+    out: dict = {"msg": kind}
+    if tag in (1, 2):  # Vote / Proposal protos: 2=height, 3=round
+        out["height"] = pb.to_i64(emb.get(2, 0))
+        out["round"] = pb.to_i64(emb.get(3, 0))
+        if tag == 1:
+            t = pb.to_i64(emb.get(1, 0))
+            out["type"] = _VOTE_TYPE_NAMES.get(t, t)
+            out["idx"] = pb.to_i64(emb.get(7, 0))
+    else:  # wrapper messages: 1=height, 2=round
+        out["height"] = pb.to_i64(emb.get(1, 0))
+        out["round"] = pb.to_i64(emb.get(2, 0))
+        if tag == 4:
+            out["step"] = pb.to_i64(emb.get(3, 0))
+        elif tag == 6:
+            pd = pb.fields_to_dict(pb.as_bytes(emb.get(3, b"")))
+            out["idx"] = pb.to_i64(pd.get(1, 0)) - 1
+        elif tag in (7, 8):
+            t = pb.to_i64(emb.get(3, 0))
+            out["type"] = _VOTE_TYPE_NAMES.get(t, t)
+    return out
+
+
+def trace_wire_msg(direction: str, peer_id: str, chan_id: int,
+                   raw: bytes) -> None:
+    """Switch msg_tracer hook: one p2p.send/p2p.recv event per consensus
+    wire message. Must never raise — a malformed frame is the receive
+    path's problem; an exception here would tear down the peer."""
+    if chan_id not in _TRACE_CHANNELS:
+        return
+    try:
+        meta = peek_wire_msg(raw)
+        if meta is None:
+            return
+        if direction == "send":
+            trace.event("p2p.send", peer=peer_id, chan=chan_id,
+                        bytes=len(raw), **meta)
+        else:
+            trace.event("p2p.recv", peer=peer_id, chan=chan_id,
+                        bytes=len(raw), **meta)
+    except Exception:  # noqa: BLE001 — tracing must not disturb p2p
+        pass
+
+
+# ----------------------------------------------------------------------
 # per-peer round state (reference internal/consensus/peer_state.go)
 # ----------------------------------------------------------------------
 class PeerState:
@@ -417,6 +495,10 @@ class ConsensusReactor(Reactor):
 
     def set_switch(self, switch) -> None:
         self.switch = switch
+        # arm the flight recorder's wire hook (no-op until tracing is
+        # enabled; the switch fans it to every peer's send/recv path)
+        if hasattr(switch, "set_msg_tracer"):
+            switch.set_msg_tracer(trace_wire_msg)
 
     def stop(self) -> None:
         self._stopped.set()

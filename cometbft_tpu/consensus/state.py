@@ -769,9 +769,13 @@ class ConsensusState:
 
     def _update_to_state(self, new_state, last_precommits: VoteSet) -> None:
         self.sm_state = new_state
+        # close the COMMIT step BEFORE bumping the height: the span must
+        # be stamped with the height that was committed, not the next
+        # one (the flight recorder's per-height reconstruction keys
+        # every step span on its height)
+        self._update_step(0, RoundStep.NEW_HEIGHT)
         self.height = new_state.last_block_height + 1
         self.validators = new_state.validators.copy()
-        self._update_step(0, RoundStep.NEW_HEIGHT)
         self.round = 0
         self.proposal = None
         self.proposal_block = None

@@ -119,9 +119,14 @@ class _ProcNode:
 class Runner:
     def __init__(self, manifest: Manifest, workdir: str,
                  starting_port: int = 0,
-                 node_commands: dict[str, list[str]] | None = None):
+                 node_commands: dict[str, list[str]] | None = None,
+                 trace: bool = True):
         self.manifest = manifest
         self.workdir = workdir
+        # every node records a flight-recorder sink by default; the
+        # overhead harness (tools/trace_overhead.py) turns it off for
+        # its baseline world
+        self.trace = trace
         # three ports per node: p2p (+2i), rpc (+2i+1), and a metrics
         # listener block after the p2p/rpc range (+2N+i)
         self.starting_port = starting_port or self._free_port_base(
@@ -214,6 +219,10 @@ class Runner:
             mport = self.starting_port + 2 * len(m.nodes) + i
             cfg.instrumentation.prometheus = True
             cfg.instrumentation.prometheus_listen_addr = f"127.0.0.1:{mport}"
+            # per-node flight-recorder sink: on failure the runner
+            # merges them into a stall-triage report (trace_report.txt)
+            if self.trace:
+                cfg.instrumentation.trace_sink = "data/trace.jsonl"
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
             self.nodes[spec.name] = _ProcNode(
@@ -426,7 +435,22 @@ class Runner:
 
     def run(self) -> None:
         """Execute the manifest: start, perturb on schedule, reach the
-        target height, stop, check invariants."""
+        target height, stop, check invariants. On failure, merge every
+        node's flight-recorder sink into ``<workdir>/trace_report.txt``
+        and append the stall triage to the raised error."""
+        try:
+            self._run_inner()
+        except E2EError as e:
+            triage = self._write_trace_report()
+            if triage:
+                raise E2EError(
+                    f"{e}\n--- flight recorder triage "
+                    f"({os.path.join(self.workdir, 'trace_report.txt')}) "
+                    f"---\n{triage}"
+                ) from e
+            raise
+
+    def _run_inner(self) -> None:
         m = self.manifest
         self.start()
         try:
@@ -457,6 +481,49 @@ class Runner:
         finally:
             self.stop_all()
         self.check_invariants()
+
+    # ----------------------------------------------------- flight recorder
+    def trace_paths(self) -> dict[str, str]:
+        """name -> existing per-node trace sink path."""
+        out = {}
+        for name, node in self.nodes.items():
+            p = os.path.join(node.home, "data", "trace.jsonl")
+            if os.path.isfile(p):
+                out[name] = p
+        return out
+
+    def merged_trace(self):
+        """Merge every node's sink (raises ValueError when none exist)."""
+        from ..utils import traceview
+
+        return traceview.merge(list(self.trace_paths().values()))
+
+    def stall_report(self) -> dict:
+        return self.merged_trace().stall_report()
+
+    def _write_trace_report(self) -> str | None:
+        """Best-effort failure triage: write summary + last critical path
+        + stall report to ``<workdir>/trace_report.txt`` and return the
+        stall-triage text. Must never raise — it runs on the error path
+        and masking the original failure would be worse than no report
+        (old-build nodes in upgrade tests have no sinks at all)."""
+        try:
+            from ..utils import traceview
+
+            mt = self.merged_trace()
+            stall = traceview.render_stall_report(mt.stall_report())
+            parts = [traceview.render_summary(mt)]
+            hs = mt.heights()
+            if hs:
+                parts.append(traceview.render_critical_path(
+                    mt.critical_path(hs[-1])))
+            parts.append(stall)
+            with open(os.path.join(self.workdir, "trace_report.txt"),
+                      "w", encoding="utf-8") as f:
+                f.write("\n\n".join(parts) + "\n")
+            return stall
+        except Exception:
+            return None
 
     def _apply(self, p) -> None:
         node = self.nodes[p.node]

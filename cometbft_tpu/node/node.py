@@ -108,6 +108,16 @@ class Node:
 
         # --- identity --------------------------------------------------
         self.node_key = NodeKey.load_or_generate(_p(config.base.node_key_file))
+        if _trace.enabled:
+            # flight recorder: every record from this process now
+            # carries the p2p node id (the merge key the traceview
+            # merger aligns per-node sinks on); node.boot maps the id
+            # to the operator-facing moniker once per process start
+            _trace.set_node(self.node_key.node_id())
+            _trace.event(
+                "node.boot", moniker=config.base.moniker,
+                node_id=self.node_key.node_id(),
+            )
         if config.base.priv_validator_laddr:
             # remote signer dials in; the key never enters this process
             # (reference node.go createAndStartPrivValidatorSocketClient)

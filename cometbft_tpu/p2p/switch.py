@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from abc import ABC, abstractmethod
 
+from ..utils import trace as _trace
 from ..utils.log import logger
 from ..utils.metrics import p2p_metrics
 from .conn import ChannelDescriptor, MConnection
@@ -34,16 +35,24 @@ class Reactor(ABC):
 
 
 class Peer:
-    def __init__(self, node_info: NodeInfo, mconn: MConnection, outbound: bool):
+    def __init__(self, node_info: NodeInfo, mconn: MConnection, outbound: bool,
+                 tracer=None):
         self.node_info = node_info
         self.mconn = mconn
         self.outbound = outbound
+        # flight-recorder hook: tracer("send"/"recv", peer_id, chan_id,
+        # raw) classifies consensus wire messages into trace records
+        # (installed by Switch.set_msg_tracer; the trace.enabled guard
+        # keeps the disabled cost at one global load)
+        self.tracer = tracer
 
     @property
     def id(self) -> str:
         return self.node_info.node_id
 
     def send(self, chan_id: int, msg: bytes) -> bool:
+        if _trace.enabled and self.tracer is not None:
+            self.tracer("send", self.id, chan_id, msg)
         return self.mconn.send(chan_id, msg)
 
     def stop(self) -> None:
@@ -89,6 +98,20 @@ class Switch:
         self._blocked: set[str] = set()
         self.partition_file: str | None = None
         self._partition_mtime: float = -1.0
+        # wire-message trace classifier (flight recorder); see
+        # set_msg_tracer
+        self.msg_tracer = None
+
+    def set_msg_tracer(self, fn) -> None:
+        """Install a wire-message trace hook, called as
+        fn(direction, peer_id, chan_id, raw_msg) on every message sent
+        to or received from any peer while tracing is enabled. The
+        consensus reactor installs its channel classifier here so
+        cross-node traces get send→recv edges without the p2p layer
+        knowing the consensus wire format."""
+        self.msg_tracer = fn
+        for peer in self.peers():
+            peer.tracer = fn
 
     # ------------------------------------------------------------------
     def add_reactor(self, reactor: Reactor) -> None:
@@ -257,6 +280,8 @@ class Switch:
         holder: dict = {}
 
         def on_receive(chan_id: int, msg: bytes) -> None:
+            if _trace.enabled and self.msg_tracer is not None:
+                self.msg_tracer("recv", holder["peer"].id, chan_id, msg)
             reactor = self._chan_owner.get(chan_id)
             if reactor is not None:
                 reactor.receive(chan_id, holder["peer"], msg)
@@ -267,7 +292,7 @@ class Switch:
         mconn = MConnection(sconn, self._descs, on_receive, on_error,
                             send_rate=self.send_rate,
                             recv_rate=self.recv_rate)
-        peer = Peer(info, mconn, outbound)
+        peer = Peer(info, mconn, outbound, tracer=self.msg_tracer)
         holder["peer"] = peer
         if peer.id in self._blocked:
             sconn.close()

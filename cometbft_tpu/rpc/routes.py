@@ -120,16 +120,32 @@ def dump_trace(env, params):
     """Tail of the node's trace sink (observability debug aid).
 
     Returns the last `n` JSONL records (default 100) written by
-    utils.trace; empty when tracing is disabled.
+    utils.trace; empty when tracing is disabled. Optional filters:
+    `name` keeps records whose span name contains the substring (e.g.
+    ``name=p2p.`` for the wire hooks), `kind` requires an exact kind
+    ("span" or "event"). With filters, the last `n` MATCHING records
+    out of the newest 1000 are returned.
     """
     from ..utils import trace
 
     n = int(params.get("n", 100) or 100)
     n = max(1, min(n, 1000))
+    name = str(params.get("name", "") or "")
+    kind = str(params.get("kind", "") or "")
+    if not trace.enabled:
+        records = []
+    elif name or kind:
+        records = [
+            r for r in trace.tail(1000)
+            if (not name or name in str(r.get("name", "")))
+            and (not kind or r.get("kind") == kind)
+        ][-n:]
+    else:
+        records = trace.tail(n)
     return {
         "enabled": trace.enabled,
         "path": trace.path() or "",
-        "records": trace.tail(n) if trace.enabled else [],
+        "records": records,
     }
 
 

@@ -3,7 +3,10 @@
 The reference ships OpenTelemetry-style consensus tracing out of tree;
 here a single-process JSONL tracer is enough to attribute wall time
 across consensus steps, ApplyBlock stages, blocksync fetch→verify→apply
-and crypto batch-verify dispatch (ISSUE 3 tentpole part 1).
+and crypto batch-verify dispatch (ISSUE 3 tentpole part 1). ISSUE 6
+grows it into the data plane of the cross-node flight recorder: every
+record carries a stable node identity, and p2p wire-message hooks give
+the merger (utils/traceview.py) send→recv edges between sinks.
 
 Design constraints:
 
@@ -11,10 +14,21 @@ Design constraints:
   hot paths guard with ``if trace.enabled:`` so the disabled cost is one
   global load. `span()` returns a shared no-op context manager so
   un-guarded ``with trace.span(...)`` sites stay cheap too.
-* One JSON object per line, flushed per record so a killed node leaves
-  a readable trace. Every record carries ``ts`` (epoch seconds), ``pid``
-  (merge safety across e2e nodes), ``name`` and ``kind`` ("span" or
-  "event"); spans add ``dur_ms``; callers attach free-form fields.
+* One JSON object per line. Writes are buffered with a bounded
+  staleness: the sink is flushed when FLUSH_INTERVAL_S has passed since
+  the last flush (checked at each emit), by `tail()`, and at graceful
+  shutdown — per-record flushing costs a syscall per consensus wire
+  message once the p2p hooks are on, which measurably slows a loaded
+  multi-node host. A SIGKILLed node loses at most the last interval's
+  records. Every record carries ``ts`` (epoch seconds), ``pid`` (merge
+  safety across e2e nodes), ``name`` and ``kind`` ("span" or "event");
+  spans add ``dur_ms``; callers attach free-form fields. Once
+  `set_node()` ran, records also carry ``node`` — the cross-process join
+  key the traceview merger aligns sinks on.
+* Fork safety: ``pid`` is re-stamped and the sink reopened via an
+  at-fork hook, so a process forked after configure() never stamps the
+  parent's pid on its records (and never shares the parent's buffered
+  file object).
 * Sink selection: `configure(path)` from node config
   (``[instrumentation] trace_sink``), or the ``COMETBFT_TPU_TRACE``
   environment variable at import time (picked up by subprocess nodes
@@ -33,35 +47,99 @@ _path: str | None = None
 _fh = None
 _lock = threading.Lock()
 _pid = os.getpid()
+_node = ""
+
+# bounded write staleness: flush at most this long after a record was
+# buffered (see module docstring — per-record flush is too expensive
+# once the p2p wire hooks multiply the record rate)
+FLUSH_INTERVAL_S = 0.25
+_last_flush = 0.0
 
 
 def configure(path: str) -> None:
     """Open (append) the JSONL sink at `path` and enable tracing."""
-    global enabled, _path, _fh, _pid
+    global enabled, _path, _fh, _pid, _last_flush
     with _lock:
         if _fh is not None:
             _fh.close()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        _fh = open(path, "a", encoding="utf-8")
+        _fh = open(path, "a", encoding="utf-8", buffering=1 << 16)
         _path = path
         _pid = os.getpid()
+        _last_flush = 0.0
         enabled = True
 
 
 def disable() -> None:
-    global enabled, _path, _fh
+    global enabled, _path, _fh, _node
     with _lock:
         enabled = False
         if _fh is not None:
             _fh.close()
         _fh = None
         _path = None
+        _node = ""
 
 
 def path() -> str | None:
     return _path
+
+
+def set_node(node_id: str) -> None:
+    """Stamp a stable node identity (p2p node id) on every subsequent
+    record. One identity per process: the first caller wins, so an
+    in-process multi-node test doesn't flap the field mid-sink (its
+    records are disambiguated by the per-message ``peer`` fields
+    instead). Cleared by disable()."""
+    global _node
+    if not _node:
+        _node = str(node_id)
+
+
+def node_id() -> str:
+    return _node
+
+
+def _before_fork() -> None:
+    # Drain the buffer in the parent so the child's inherited copy is
+    # empty — otherwise the child's close() below would re-write records
+    # the parent also flushes later (duplicate lines in the sink).
+    try:
+        with _lock:
+            if _fh is not None:
+                _fh.flush()
+    except Exception:  # noqa: BLE001 — fork must proceed regardless
+        pass
+
+
+def _after_fork_in_child() -> None:
+    # A forked child must stamp its OWN pid and must not share the
+    # parent's buffered file object (interleaved partial writes). The
+    # lock is replaced too: another thread may have held it at fork
+    # time, which would deadlock the child forever.
+    global _pid, _fh, _lock, _last_flush
+    _lock = threading.Lock()
+    _pid = os.getpid()
+    # first emit in the child flushes at once: multiprocessing children
+    # exit via os._exit(), which skips buffered-file shutdown
+    _last_flush = 0.0
+    if _fh is not None:
+        try:
+            _fh.close()
+        except OSError:
+            pass
+        try:
+            _fh = open(_path, "a", encoding="utf-8", buffering=1 << 16) \
+                if _path else None
+        except OSError:
+            _fh = None
+
+
+if hasattr(os, "register_at_fork"):  # POSIX only; harmless otherwise
+    os.register_at_fork(before=_before_fork,
+                        after_in_child=_after_fork_in_child)
 
 
 def emit(name: str, kind: str = "event", **fields) -> None:
@@ -69,13 +147,26 @@ def emit(name: str, kind: str = "event", **fields) -> None:
     if not enabled:
         return
     rec = {"ts": time.time(), "pid": _pid, "name": name, "kind": kind}
+    if _node:
+        rec["node"] = _node
     rec.update(fields)
     line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+    global _last_flush
     with _lock:
         if _fh is None:  # raced with disable()
             return
         _fh.write(line)
-        _fh.flush()
+        now = time.monotonic()
+        if now - _last_flush >= FLUSH_INTERVAL_S:
+            _fh.flush()
+            _last_flush = now
+
+
+def flush() -> None:
+    """Force buffered records to disk (readers that bypass tail())."""
+    with _lock:
+        if _fh is not None:
+            _fh.flush()
 
 
 def event(name: str, **fields) -> None:
@@ -126,7 +217,13 @@ def span(name: str, **fields):
 
 
 def tail(n: int = 100) -> list[dict]:
-    """Last `n` parsed records from the sink (for the dump_trace RPC)."""
+    """Last `n` parsed records from the sink (for the dump_trace RPC).
+
+    The seek-back window starts at 256 KiB and grows geometrically until
+    it holds `n` parseable lines or reaches the beginning of the file,
+    so large `n` (or oversized records) can't silently come up short.
+    A window that starts mid-file drops its first line — it may be a
+    truncated record half — but at BOF the first line is kept."""
     p = _path
     if p is None or not os.path.exists(p):
         return []
@@ -136,15 +233,43 @@ def tail(n: int = 100) -> list[dict]:
     with open(p, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
-        f.seek(max(0, size - 256 * 1024))
-        lines = f.read().decode("utf-8", "replace").splitlines()
-    out = []
-    for line in lines[-n:]:
-        try:
-            out.append(json.loads(line))
-        except ValueError:
-            continue
-    return out
+        window = 256 * 1024
+        while True:
+            start = max(0, size - window)
+            f.seek(start)
+            lines = f.read().decode("utf-8", "replace").splitlines()
+            if start > 0 and lines:
+                lines = lines[1:]
+            out = []
+            for line in lines:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+            if len(out) >= n or start == 0:
+                return out[-n:]
+            window *= 4
+
+
+# ----------------------------------------------------------------------
+# Span-name registry: every name passed to trace.span()/trace.event()/
+# trace.emit() anywhere in the tree must be declared here, and every
+# declared name must have a live call site — tools/trace_lint.py
+# enforces both directions from the tier-1 suite. The flight-recorder
+# analysis layer (utils/traceview.py, tools/trace_analyze.py) keys its
+# reconstruction on these names, so renaming one is a cross-cutting
+# change, not a local edit.
+SPAN_REGISTRY = {
+    "node.boot": "node identity: moniker + full node id, once per process start",
+    "consensus.step": "span closing the consensus step being left (height/round/dur_ms/next)",
+    "consensus.finalize_commit": "block decided at height/round, with tx count",
+    "state.apply_block": "ApplyBlock with validate/finalize/commit/save stage breakdown",
+    "blocksync.block": "one fast-synced block: fetch→verify→apply breakdown",
+    "crypto.batch_verify": "one batch-verify dispatch: path, n, modeled host/wire/device terms",
+    "crypto.commit_partition": "per-curve share of one commit verification",
+    "p2p.send": "consensus wire message handed to a peer (msg/height/round/peer)",
+    "p2p.recv": "consensus wire message received from a peer (msg/height/round/peer)",
+}
 
 
 _env = os.environ.get("COMETBFT_TPU_TRACE")

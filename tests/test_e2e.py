@@ -38,6 +38,43 @@ def test_e2e_perturbed_testnet(tmp_path):
     # a majority of nodes (the never-killed ones at minimum) kept up
     assert sum(1 for h in report["heights"].values() if h >= 10) >= 2
 
+    # ---- flight recorder over the real world: every node left a sink;
+    # the merger aligns them into one per-height timeline with
+    # gossip/verify/apply attribution, and the stall triage on a
+    # healthy-if-perturbed run is clean
+    import subprocess
+    import sys
+
+    from cometbft_tpu.utils import traceview
+
+    sinks = r.trace_paths()
+    assert set(sinks) == {f"node{i}" for i in range(4)}
+    mt = r.merged_trace()
+    assert len(mt.traces) == 4
+    heights = mt.heights()
+    assert heights and heights[-1] >= 10
+    cp = mt.critical_path(heights[-1])
+    assert cp["committed"] is True
+    # at least the quorum that stayed up has full attribution
+    attributed = [nd for nd in cp["per_node"].values() if "verify_ms" in nd]
+    assert len(attributed) >= 2
+    assert all(nd["verify_ms"] >= 0 and nd["apply_ms"] >= 0
+               for nd in attributed)
+    tl = mt.timeline(height=heights[-1])
+    assert any(rec["name"] == "p2p.recv" for rec in tl)
+    assert [rec["_t"] for rec in tl] == sorted(rec["_t"] for rec in tl)
+    rep = mt.stall_report()
+    assert rep["status"] == "ok", traceview.render_stall_report(rep)
+    # the CLI agrees (exit 0 = no stall) straight off the workdir
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_analyze.py"),
+         "stall", str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
 
 @pytest.mark.skipif(
     _CORES < 4,

@@ -204,6 +204,19 @@ def test_metrics_lint_all_bundles_driven():
     assert p.returncode == 0, p.stderr
 
 
+def test_trace_lint_registry_matches_call_sites():
+    """tools/trace_lint.py: every emitted span name is declared in
+    trace.SPAN_REGISTRY and every declared name has a live call site
+    (the flight-recorder analyzers key on these literals)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_lint.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+
+
 def test_logger_levels_and_fields():
     records = []
     cmtlog.set_sink(lambda level, msg, fields: records.append((level, msg, fields)))
@@ -355,6 +368,22 @@ def test_node_serves_metrics_and_trace(tmp_path):
             for r in crypto_spans
         )
 
+        # flight-recorder records: node identity stamped once, and the
+        # p2p wire hooks classified consensus messages in BOTH
+        # directions with height/round and the sender/receiver peer id
+        boots = [r for r in recs if r["name"] == "node.boot"]
+        assert boots and boots[0]["node_id"] == n.node_key.node_id()
+        assert any(r.get("node") == n.node_key.node_id() for r in recs)
+        for direction in ("p2p.send", "p2p.recv"):
+            wire = [r for r in recs if r["name"] == direction]
+            assert wire, f"no {direction} records"
+            assert all(
+                "peer" in r and "msg" in r and "height" in r for r in wire
+            )
+        assert {r["msg"] for r in recs if r["name"] == "p2p.recv"} & {
+            "vote", "proposal", "block_part", "new_round_step",
+        }
+
         # dump_trace RPC serves the same tail (GET-URI dispatch)
         rhost, rport = n.rpc_addr
         out = json.loads(urllib.request.urlopen(
@@ -366,6 +395,13 @@ def test_node_serves_metrics_and_trace(tmp_path):
         assert any(
             r["name"].startswith("consensus.") for r in res["records"]
         )
+        # ?name= substring filter narrows to the wire hooks
+        out = json.loads(urllib.request.urlopen(
+            f"http://{rhost}:{rport}/dump_trace?n=20&name=p2p.recv",
+            timeout=5,
+        ).read())
+        filt = out["result"]["records"]
+        assert filt and all(r["name"] == "p2p.recv" for r in filt)
     finally:
         n1.stop()
         n.stop()

@@ -26,6 +26,41 @@ def test_query_language():
         Query("tm.event ~ 'x'")
 
 
+def test_dump_trace_name_and_kind_filters(tmp_path):
+    """dump_trace honors `name` (substring) and `kind` (exact) filter
+    params — the GET-URI dispatch hands them over as strings, so this
+    drives the handler exactly as /dump_trace?name=...&kind=... does."""
+    from cometbft_tpu.rpc.routes import dump_trace
+    from cometbft_tpu.utils import trace
+
+    trace.configure(os.path.join(str(tmp_path), "trace.jsonl"))
+    try:
+        for h in range(3):
+            trace.event("p2p.recv", msg="vote", height=h)
+            trace.event("p2p.send", msg="vote", height=h)
+            trace.emit("state.apply_block", "span", height=h, dur_ms=1.0)
+        res = dump_trace(None, {"n": "50"})
+        assert len(res["records"]) == 9
+        res = dump_trace(None, {"n": "50", "name": "p2p.recv"})
+        assert [r["name"] for r in res["records"]] == ["p2p.recv"] * 3
+        # substring match catches both directions of the wire hooks
+        res = dump_trace(None, {"n": "50", "name": "p2p."})
+        assert len(res["records"]) == 6
+        # kind narrows to spans; combined filters intersect
+        res = dump_trace(None, {"n": "50", "kind": "span"})
+        assert [r["name"] for r in res["records"]] == (
+            ["state.apply_block"] * 3
+        )
+        res = dump_trace(None, {"n": "1", "name": "p2p.", "kind": "event"})
+        assert len(res["records"]) == 1
+        assert res["records"][0]["height"] == 2
+        # no matches -> empty, not an error
+        assert dump_trace(None, {"name": "nope"})["records"] == []
+    finally:
+        trace.disable()
+    assert dump_trace(None, {})["enabled"] is False
+
+
 def test_pubsub_routing():
     srv = PubSubServer()
     sub_blocks = srv.subscribe("c1", "tm.event = 'NewBlock'")

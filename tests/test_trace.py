@@ -53,6 +53,7 @@ def test_tracer_jsonl_schema_and_tail(tmp_path):
         trace.event("consensus.step", height=4, round=0, step="PROPOSE")
         with trace.span("state.apply_block", height=4, txs=7) as s:
             s.add(validate_ms=0.1)
+        trace.flush()  # writes are buffered with bounded staleness
         records = [
             json.loads(line)
             for line in open(sink, encoding="utf-8")
@@ -77,6 +78,73 @@ def test_tracer_jsonl_schema_and_tail(tmp_path):
     assert trace.enabled is False
     trace.emit("late")
     assert sum(1 for _ in open(sink, encoding="utf-8")) == 2
+
+
+def test_tail_window_grows_past_initial_seek(tmp_path):
+    """tail(n) starts from a 256 KiB seek-back; when `n` lines do not
+    fit it must widen the window instead of silently shorting the RPC
+    (the old fixed window capped tail() at whatever fit in 256 KiB)."""
+    sink = os.path.join(str(tmp_path), "big.jsonl")
+    trace.configure(sink)
+    try:
+        pad = "x" * 220  # ~260 B/record -> 3000 records ≈ 780 KiB
+        for i in range(3000):
+            trace.event("grow", i=i, pad=pad)
+        assert os.path.getsize(sink) > 256 * 1024
+        got = trace.tail(2500)
+        assert len(got) == 2500
+        assert got[0]["i"] == 500 and got[-1]["i"] == 2999
+        # n beyond the file returns every record, first line included
+        assert len(trace.tail(100_000)) == 3000
+        assert trace.tail(100_000)[0]["i"] == 0
+    finally:
+        _cleanup()
+
+
+def test_fork_child_stamps_own_pid(tmp_path):
+    """A process forked after configure() must stamp its own pid (and
+    not scribble through the parent's buffered file object)."""
+    import multiprocessing
+
+    try:
+        ctx = multiprocessing.get_context("fork")
+    except ValueError:
+        import pytest
+
+        pytest.skip("platform has no fork start method")
+    sink = os.path.join(str(tmp_path), "fork.jsonl")
+    trace.configure(sink)
+    try:
+        trace.event("parent.mark")
+        proc = ctx.Process(target=trace.event, args=("child.mark",))
+        proc.start()
+        proc.join(30)
+        assert proc.exitcode == 0
+        trace.flush()  # the child flushed at exit; flush our own buffer
+        recs = [json.loads(line) for line in open(sink, encoding="utf-8")]
+        by_name = {r["name"]: r for r in recs}
+        assert by_name["parent.mark"]["pid"] == os.getpid()
+        assert by_name["child.mark"]["pid"] != os.getpid()
+    finally:
+        _cleanup()
+
+
+def test_set_node_first_caller_wins(tmp_path):
+    sink = os.path.join(str(tmp_path), "node.jsonl")
+    trace.configure(sink)
+    try:
+        trace.event("before")
+        trace.set_node("aabb" * 10)
+        trace.set_node("ffff" * 10)  # in-process second node: ignored
+        assert trace.node_id() == "aabb" * 10
+        trace.event("after")
+        trace.flush()
+        recs = [json.loads(line) for line in open(sink, encoding="utf-8")]
+        assert "node" not in recs[0]
+        assert recs[1]["node"] == "aabb" * 10
+    finally:
+        _cleanup()
+    assert trace.node_id() == ""  # disable() clears the identity
 
 
 def test_tracer_env_var_configures_subprocess(tmp_path):

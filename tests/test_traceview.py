@@ -1,0 +1,319 @@
+"""Flight-recorder merger tests: golden synthetic multi-node worlds.
+
+The builder emits per-node JSONL sinks with CONTROLLED clock skews —
+every node stamps ``true_time + skew[node]`` — so the tests can assert
+the merger recovers the skews from send→recv pairs alone, orders the
+merged timeline causally, attributes the per-height critical path, and
+triages a reproduction of the rejoin stall (ROADMAP item: node stuck
+at height H with rounds advancing while peers commit on — the
+classifier must name the node and the missing catchup precommits)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cometbft_tpu.utils import traceview
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LATENCY = 0.01  # symmetric one-way latency in the synthetic worlds
+
+
+class WorldBuilder:
+    """Synthetic N-node testnet emitting per-node trace records.
+
+    All `t` arguments are TRUE time (seconds); each record lands in its
+    node's sink stamped with ``t + skew[node]``."""
+
+    def __init__(self, skews: dict[str, float]):
+        self.names = list(skews)
+        self.skews = skews
+        # deterministic 40-hex node ids, node0 -> "0000...", etc.
+        self.ids = {n: f"{i:02x}" * 20 for i, n in enumerate(self.names)}
+        self.records: dict[str, list] = {n: [] for n in self.names}
+        for n in self.names:
+            self.emit(n, 0.0, "node.boot", moniker=n,
+                      node_id=self.ids[n])
+
+    def emit(self, node: str, t: float, name: str, kind="event", **fields):
+        rec = {"ts": 1000.0 + t + self.skews[node], "pid": 1,
+               "name": name, "kind": kind, "node": self.ids[node]}
+        rec.update(fields)
+        self.records[node].append(rec)
+
+    def wire(self, src: str, dst: str, t: float, **meta):
+        """One gossiped message: p2p.send at src, p2p.recv at dst."""
+        self.emit(src, t, "p2p.send", peer=self.ids[dst], chan=0x21,
+                  bytes=64, **meta)
+        self.emit(dst, t + LATENCY, "p2p.recv", peer=self.ids[src],
+                  chan=0x21, bytes=64, **meta)
+
+    def commit_height(self, h: int, t: float, proposer: str | None = None,
+                      nodes: list[str] | None = None):
+        """One clean consensus height: proposal + part gossip from the
+        proposer, prevote/precommit exchange, steps, commit, apply."""
+        proposer = proposer or self.names[0]
+        nodes = nodes or self.names
+        for dst in nodes:
+            if dst != proposer:
+                self.wire(proposer, dst, t,
+                          msg="proposal", height=h, round=0)
+                self.wire(proposer, dst, t + 0.002,
+                          msg="block_part", height=h, round=0, idx=0)
+        for ty in ("prevote", "precommit"):
+            off = 0.02 if ty == "prevote" else 0.04
+            for i, src in enumerate(nodes):
+                for dst in nodes:
+                    if dst != src:
+                        self.wire(src, dst, t + off,
+                                  msg="vote", height=h, round=0,
+                                  type=ty, idx=i)
+        for n in nodes:
+            self.emit(n, t + 0.055, "consensus.step", kind="span",
+                      step="PROPOSE", height=h, round=0, dur_ms=20.0,
+                      next="PREVOTE")
+            self.emit(n, t + 0.075, "consensus.step", kind="span",
+                      step="PREVOTE", height=h, round=0, dur_ms=20.0,
+                      next="PRECOMMIT")
+            self.emit(n, t + 0.095, "consensus.step", kind="span",
+                      step="PRECOMMIT", height=h, round=0, dur_ms=20.0,
+                      next="COMMIT")
+            self.emit(n, t + 0.1, "consensus.finalize_commit",
+                      height=h, round=0, txs=2)
+            self.emit(n, t + 0.12, "state.apply_block", kind="span",
+                      height=h, txs=2, dur_ms=15.0, validate_ms=9.0,
+                      finalize_ms=3.0, commit_ms=2.0, save_events_ms=1.0)
+
+    def write(self, root) -> str:
+        for n in self.names:
+            d = os.path.join(str(root), n, "data")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "trace.jsonl"), "w") as f:
+                for rec in self.records[n]:
+                    f.write(json.dumps(rec) + "\n")
+        return str(root)
+
+
+SKEWS = {"node0": 0.0, "node1": 2.0, "node2": -1.5, "node3": 0.3}
+
+
+def healthy_world(tmp_path, skews=SKEWS, heights=5):
+    w = WorldBuilder(skews)
+    for h in range(1, heights + 1):
+        w.commit_height(h, 1.0 * h)
+    return w, w.write(tmp_path)
+
+
+def rejoin_stall_world(tmp_path):
+    """ROADMAP rejoin-stall reproduction: node3 reboots after height 4
+    and sticks at height 5 — rounds advance 0..8, the block data
+    arrives, but NO precommits (catchup votes) ever do — while the
+    other three commit on to height 12."""
+    w = WorldBuilder(SKEWS)
+    for h in range(1, 5):
+        w.commit_height(h, 1.0 * h)
+    # node3 reboots (new process) and is stuck at height 5 from t=20
+    w.emit("node3", 20.0, "node.boot", moniker="node3",
+           node_id=w.ids["node3"])
+    live = ["node0", "node1", "node2"]
+    for h in range(5, 13):
+        w.commit_height(h, 5.0 + (h - 5) * 2.5, nodes=live)
+    # the stuck node gets the proposal + parts for height 5 re-gossiped
+    w.wire("node0", "node3", 21.0, msg="proposal", height=5, round=0)
+    w.wire("node0", "node3", 21.01, msg="block_part", height=5,
+           round=0, idx=0)
+    # peers keep talking to it (so it is connected, not isolated) ...
+    for i, src in enumerate(live):
+        w.wire(src, "node3", 24.0 + i, msg="new_round_step",
+               height=13, round=0, step=3)
+    # ... while its own rounds churn in place until the end of the world
+    for r in range(0, 9):
+        t = 21.0 + r * 2.0
+        w.emit("node3", t, "consensus.step", kind="span",
+               step="PROPOSE", height=5, round=r, dur_ms=600.0,
+               next="PREVOTE")
+        w.emit("node3", t + 1.0, "consensus.step", kind="span",
+               step="PREVOTE", height=5, round=r, dur_ms=400.0,
+               next="NEW_ROUND")
+    return w, w.write(tmp_path)
+
+
+# ---------------------------------------------------------------- merge
+def test_merge_recovers_controlled_skews(tmp_path):
+    _, root = healthy_world(tmp_path)
+    mt = traceview.merge([root])
+    assert len(mt.traces) == 4
+    names = {t.name for t in mt.traces}
+    assert names == set(SKEWS)
+    # offsets are relative to the reference node: pairwise differences
+    # must match the planted skews (symmetric latency cancels exactly)
+    off = {mt.display_name(k): v for k, v in mt.offsets.items()}
+    for a in SKEWS:
+        for b in SKEWS:
+            want = SKEWS[a] - SKEWS[b]
+            got = off[a] - off[b]
+            assert abs(got - want) < 1e-6, (a, b, got, want)
+
+
+def test_merge_aligns_large_skew(tmp_path):
+    # ±30s skews: raw timestamps are wildly misordered across sinks,
+    # adjusted ones must still be causal
+    skews = {"node0": 0.0, "node1": 30.0, "node2": -30.0}
+    w = WorldBuilder(skews)
+    for h in range(1, 4):
+        w.commit_height(h, 1.0 * h)
+    mt = traceview.merge([w.write(tmp_path)])
+    off = {mt.display_name(k): v for k, v in mt.offsets.items()}
+    assert abs((off["node1"] - off["node2"]) - 60.0) < 1e-6
+    # causality: every recv at/after the matching send on the merged clock
+    sends = {}
+    for r in mt.records:
+        if r["name"] == "p2p.send":
+            k = (r["_node"], r["peer"], r.get("msg"), r.get("height"),
+                 r.get("type"), r.get("idx"))
+            sends.setdefault(k, r["_t"])
+    for r in mt.records:
+        if r["name"] == "p2p.recv":
+            k = (r["peer"], r["_node"], r.get("msg"), r.get("height"),
+                 r.get("type"), r.get("idx"))
+            if k in sends:
+                assert r["_t"] >= sends[k] - 1e-9
+
+
+def test_merged_timeline_and_heights(tmp_path):
+    _, root = healthy_world(tmp_path)
+    mt = traceview.merge([root])
+    assert mt.heights() == [1, 2, 3, 4, 5]
+    tl = mt.timeline(height=3)
+    assert tl and all(r.get("height") == 3 for r in tl)
+    # adjusted order is monotonic
+    ts = [r["_t"] for r in tl]
+    assert ts == sorted(ts)
+    # the per-height view mixes all four nodes
+    assert {mt.display_name(r["_node"]) for r in tl} == set(SKEWS)
+    assert any(r["name"] == "p2p.recv" for r in tl)
+
+
+# -------------------------------------------------------- critical path
+def test_critical_path_attribution(tmp_path):
+    _, root = healthy_world(tmp_path)
+    mt = traceview.merge([root])
+    cp = mt.critical_path(5)
+    assert cp["committed"] is True
+    assert cp["proposer"] == "node0"
+    assert set(cp["per_node"]) == set(SKEWS)
+    for name, nd in cp["per_node"].items():
+        assert nd["verify_ms"] == pytest.approx(9.0)
+        assert nd["apply_ms"] == pytest.approx(6.0)
+        assert nd["prevote_ms"] == pytest.approx(20.0)
+        if name != "node0":  # non-proposers saw the parts in flight
+            assert 0.0 < nd["gossip_ms"] < 1000.0
+    assert cp["wall_ms"] and cp["wall_ms"] > 0
+    assert cp["phase_ms"]["verify_ms"] == pytest.approx(9.0)
+    txt = traceview.render_critical_path(cp)
+    assert "height 5" in txt and "node3" in txt
+
+
+def test_critical_path_uncommitted_height(tmp_path):
+    _, root = healthy_world(tmp_path)
+    mt = traceview.merge([root])
+    cp = mt.critical_path(99)
+    assert cp["committed"] is False
+    assert cp["per_node"] == {}
+
+
+# ---------------------------------------------------------- stall triage
+def test_stall_report_healthy_world_is_ok(tmp_path):
+    _, root = healthy_world(tmp_path)
+    mt = traceview.merge([root])
+    rep = mt.stall_report()
+    assert rep["status"] == "ok"
+    assert rep["tip"] == 5
+    assert rep["stalled"] == []
+
+
+def test_stall_report_names_rejoin_stall(tmp_path):
+    _, root = rejoin_stall_world(tmp_path)
+    mt = traceview.merge([root])
+    rep = mt.stall_report()
+    assert rep["status"] == "stall"
+    assert rep["tip"] == 12
+    assert len(rep["stalled"]) == 1
+    s = rep["stalled"][0]
+    # names the stalled node, its stuck height, and the round churn
+    assert s["node"] == "node3"
+    assert s["height"] == 5
+    assert s["max_round"] == 8
+    # ... and the first absent message class: the catchup precommits
+    assert s["first_missing"] == "precommit"
+    assert "catchup" in s["detail"]
+    # block data arrived; votes did not
+    assert s["recv_counts"].get("block_part", 0) >= 1
+    assert s["recv_counts"].get("precommit", 0) == 0
+    # the connected-but-silent peers are named
+    assert set(s["silent_peers"]) == {"node0", "node1", "node2"}
+    txt = traceview.render_stall_report(rep)
+    assert "STALLED node3" in txt
+    assert "precommit" in txt
+
+
+def test_stall_report_dead_node_not_flagged(tmp_path):
+    # a node whose sink simply STOPS (crash) is dead, not stalled —
+    # different triage, must not be reported as live-but-stuck
+    w = WorldBuilder(SKEWS)
+    for h in range(1, 5):
+        w.commit_height(h, 1.0 * h)
+    live = ["node0", "node1", "node2"]
+    for h in range(5, 13):
+        w.commit_height(h, 5.0 + (h - 5) * 2.5, nodes=live)
+    mt = traceview.merge([w.write(tmp_path)])
+    rep = mt.stall_report()
+    assert rep["status"] == "ok"
+    assert rep["nodes"]["node3"]["live"] is False
+
+
+# -------------------------------------------------------------- the CLI
+def _analyze(args, cwd):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_analyze.py"),
+         *args],
+        cwd=cwd, capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_stall_exit_codes(tmp_path):
+    _, root = rejoin_stall_world(tmp_path / "bad")
+    p = _analyze(["stall", root], str(tmp_path))
+    assert p.returncode == 1, p.stderr
+    assert "STALLED node3" in p.stdout
+    assert "precommit" in p.stdout
+
+    _, ok_root = healthy_world(tmp_path / "good")
+    p = _analyze(["stall", ok_root], str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    assert "OK" in p.stdout
+
+
+def test_cli_summary_timeline_critical_path(tmp_path):
+    _, root = healthy_world(tmp_path)
+    p = _analyze(["summary", root], str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    assert "4 node(s)" in p.stdout
+
+    p = _analyze(["timeline", root, "--height", "2", "--limit", "10"],
+                 str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    assert "p2p.recv" in p.stdout or "consensus.step" in p.stdout
+
+    p = _analyze(["critical-path", root, "--json"], str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    cp = json.loads(p.stdout)
+    assert cp["height"] == 5 and cp["committed"] is True
+
+    p = _analyze(["stall", root, "--json"], str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    assert json.loads(p.stdout)["status"] == "ok"

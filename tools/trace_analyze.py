@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Flight-recorder analyzer: merge per-node trace sinks and answer
+"where did the time go" / "why is it stuck" from the command line.
+
+    python tools/trace_analyze.py summary       <paths...>
+    python tools/trace_analyze.py timeline      <paths...> [--height H]
+    python tools/trace_analyze.py critical-path <paths...> [--height H]
+    python tools/trace_analyze.py stall         <paths...>
+
+`paths` are trace sink files or directories (an e2e workdir is
+expanded to every ``node*/data/trace.jsonl`` under it; default: the
+current directory). `--json` prints the raw analysis dict instead of
+text. `stall` exits 1 when a live-but-stalled node is detected, so it
+can gate CI and the e2e runner's failure path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.utils import traceview  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=(
+        "summary", "timeline", "critical-path", "stall"))
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="trace sink files or node/workdir directories "
+                         "(default: .)")
+    ap.add_argument("--height", type=int, default=None,
+                    help="height to analyze (default: last committed)")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="timeline: show at most N records (0 = all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw analysis dict as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        mt = traceview.merge(args.paths or ["."])
+    except ValueError as e:
+        print(f"trace_analyze: {e}", file=sys.stderr)
+        return 2
+
+    if args.command == "summary":
+        if args.as_json:
+            print(json.dumps(mt.summary(), indent=2, default=str))
+        else:
+            print(traceview.render_summary(mt))
+        return 0
+
+    if args.command == "timeline":
+        recs = mt.timeline(height=args.height)
+        if args.as_json:
+            print(json.dumps(recs[-args.limit:] if args.limit else recs,
+                             default=str))
+        else:
+            print(traceview.render_timeline(recs, mt, limit=args.limit))
+        return 0
+
+    if args.command == "critical-path":
+        heights = [args.height] if args.height is not None else (
+            mt.heights() or [])
+        if not heights:
+            print("critical-path: no committed heights in trace",
+                  file=sys.stderr)
+            return 2
+        if args.height is None:
+            heights = heights[-1:]
+        for h in heights:
+            cp = mt.critical_path(h)
+            if args.as_json:
+                print(json.dumps(cp, default=str))
+            else:
+                print(traceview.render_critical_path(cp))
+        return 0
+
+    # stall
+    rep = mt.stall_report()
+    if args.as_json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(traceview.render_stall_report(rep))
+    return 1 if rep["status"] == "stall" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

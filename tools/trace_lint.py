@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Trace lint: the span-name registry and the call sites must agree.
+
+The flight-recorder analysis layer (utils/traceview.py,
+tools/trace_analyze.py) keys its reconstruction on literal span names,
+so a name emitted but not declared in `trace.SPAN_REGISTRY` is
+invisible to triage docs, and a declared name with no live call site is
+a stale promise. This lint extracts every literal first argument to
+trace.span()/trace.event()/trace.emit() across the package (plus tools/
+and bench.py) and checks both directions. Exits 1 on any mismatch.
+
+Run directly (`python tools/trace_lint.py`) or via the tier-1 suite
+(tests/test_observability.py wraps main()).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "cometbft_tpu")
+
+# the tracer itself and the analyzers mention names generically or as
+# data, not as emission sites
+EXCLUDE = {
+    os.path.join(PKG, "utils", "trace.py"),
+    os.path.join(PKG, "utils", "traceview.py"),
+    os.path.abspath(__file__),
+}
+
+# literal name in trace.span("x")/trace.event("x")/trace.emit("x", ...)
+# including the `_trace` alias used by modules avoiding name clashes
+CALL_RE = re.compile(
+    r"\b_?trace\.(?:span|event|emit)\(\s*[\"']([^\"']+)[\"']")
+
+
+def _source_files():
+    roots = [PKG, os.path.join(REPO, "tools")]
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    bench = os.path.join(REPO, "bench.py")
+    if os.path.exists(bench):
+        yield bench
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from cometbft_tpu.utils.trace import SPAN_REGISTRY
+
+    used: dict[str, list[str]] = {}
+    for path in _source_files():
+        if os.path.abspath(path) in {os.path.abspath(e) for e in EXCLUDE}:
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for m in CALL_RE.finditer(src):
+            used.setdefault(m.group(1), []).append(
+                os.path.relpath(path, REPO))
+
+    undeclared = sorted(set(used) - set(SPAN_REGISTRY))
+    unused = sorted(set(SPAN_REGISTRY) - set(used))
+    ok = True
+    if undeclared:
+        ok = False
+        print("span names emitted but missing from trace.SPAN_REGISTRY:",
+              file=sys.stderr)
+        for n in undeclared:
+            print(f"  {n}  ({', '.join(sorted(set(used[n])))})",
+                  file=sys.stderr)
+    if unused:
+        ok = False
+        print("span names declared in trace.SPAN_REGISTRY but never "
+              "emitted:", file=sys.stderr)
+        for n in unused:
+            print(f"  {n}", file=sys.stderr)
+    if not ok:
+        return 1
+    print(f"trace lint: {len(SPAN_REGISTRY)} registered span names, "
+          "all emitted and declared")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Flight-recorder overhead harness: measure the block-rate cost of
+per-node trace sinks on a real multi-node world.
+
+Runs the same N-node manifest twice — sinks off (baseline), sinks on —
+and compares blocks/second to a fixed target height. The acceptance
+bar for the recorder is <5% degradation: tracing is per-record-flushed
+JSONL plus a cheap wire-message peek per consensus frame, so the cost
+should be dominated by consensus timeouts, not the tracer.
+
+    JAX_PLATFORMS=cpu python tools/trace_overhead.py \
+        [--nodes 4] [--height 8] [--runs 1] [--json]
+
+Prints a JSON summary; exits 1 when the traced world is more than 5%
+slower than baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.e2e import Manifest, Runner  # noqa: E402
+
+
+def _world(nodes: int, height: int, timeout_s: float) -> Manifest:
+    return Manifest.parse({
+        "chain_id": "overhead",
+        "nodes": [{"name": f"node{i}"} for i in range(nodes)],
+        "target_height": height,
+        "tx_rate": 10.0,
+        "timeout_s": timeout_s,
+    })
+
+
+def _run_once(nodes: int, height: int, timeout_s: float,
+              trace: bool) -> dict:
+    workdir = tempfile.mkdtemp(prefix="trace-overhead-")
+    r = Runner(_world(nodes, height, timeout_s), workdir, trace=trace)
+    try:
+        r.setup()
+        t0 = time.monotonic()
+        r.run()
+        elapsed = time.monotonic() - t0
+        reached = r.check_invariants()["heights"]
+        h = max(reached.values())
+        out = {
+            "trace": trace, "elapsed_s": round(elapsed, 3),
+            "height": h, "blocks_per_s": round(h / elapsed, 4),
+        }
+        if trace:
+            sinks = r.trace_paths()
+            out["sink_bytes"] = sum(
+                os.path.getsize(p) for p in sinks.values())
+            out["sinks"] = len(sinks)
+        return out
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--height", type=int, default=8)
+    ap.add_argument("--runs", type=int, default=1,
+                    help="repetitions per config; best rate wins "
+                         "(suppresses scheduler noise)")
+    ap.add_argument("--timeout", type=float, default=150.0)
+    ap.add_argument("--budget-pct", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    results = {"baseline": [], "traced": []}
+    for _ in range(args.runs):
+        results["baseline"].append(
+            _run_once(args.nodes, args.height, args.timeout, trace=False))
+        results["traced"].append(
+            _run_once(args.nodes, args.height, args.timeout, trace=True))
+    base = max(r["blocks_per_s"] for r in results["baseline"])
+    traced = max(r["blocks_per_s"] for r in results["traced"])
+    degradation_pct = round((1.0 - traced / base) * 100.0, 2)
+    summary = {
+        "nodes": args.nodes, "target_height": args.height,
+        "baseline_blocks_per_s": base, "traced_blocks_per_s": traced,
+        "degradation_pct": degradation_pct,
+        "budget_pct": args.budget_pct,
+        "within_budget": degradation_pct <= args.budget_pct,
+        "runs": results,
+    }
+    print(json.dumps(summary, indent=None if args.as_json else 2))
+    return 0 if summary["within_budget"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
