@@ -163,6 +163,15 @@ def main():
             "rlc": _cap(model["rlc"], chips=8),
         },
     }
+    if "mesh" in model:
+        # live mesh term (parallel/mesh engine active): unlike the
+        # 8-chip extrapolation above, this uses the CALIBRATED shard
+        # H2D + collective costs, so the cap reflects what dispatch
+        # actually compares against the single-chip paths
+        ceiling["sigs_per_sec_cap_mesh"] = {
+            "mesh": _cap(model["mesh"]),
+            "n_devices": model["n_devices"],
+        }
 
     # snapshot of the run's crypto instrumentation: which dispatch paths
     # fired, the observed batch-size distribution, and per-path verify

@@ -60,6 +60,7 @@ class ReplayEngine:
         verify_mode: str = "batched",
         window: int = 64,
         backend: str = "tpu",
+        depth: int | None = None,
     ):
         # window=64 default: each window resolve pays one device->host
         # round trip (~100 ms on a tunneled runtime), so fewer, larger
@@ -72,6 +73,20 @@ class ReplayEngine:
         self.verify_mode = verify_mode
         self.window = window
         self.backend = backend
+        # in-flight window count: None = auto (see _pipeline_depth)
+        self.depth = depth
+
+    def _pipeline_depth(self) -> int:
+        """Windows in flight at once. Single device: 2 (device verifies
+        w+1 while the host applies w — deeper queues just park work
+        behind one chip). Mesh: 1 + n_devices, so round-robin streaming
+        keeps EVERY chip holding a window while the host applies."""
+        if self.depth:
+            return max(1, int(self.depth))
+        eng = ed25519._mesh_engine()
+        if eng is not None and eng.n_devices > 1:
+            return 1 + eng.n_devices
+        return 2
 
     def _commit_for(self, height: int) -> Commit | None:
         c = self.store.load_block_commit(height)
@@ -286,17 +301,22 @@ class ReplayEngine:
     def run(self, state, to_height: int | None = None) -> tuple[object, ReplayStats]:
         """Replay from state.last_block_height+1 to `to_height` (or tip).
 
-        Batched mode pipelines depth-2: window w+1's signature batch is
-        on the device while the host applies window w's blocks (sound
-        within a constant-validator-set span: w+1's verification inputs
-        — validator set and predecessor block id — are known before w is
-        applied; across a set change the pipeline drains and re-queues
-        with the post-apply state)."""
+        Batched mode pipelines depth-N (_pipeline_depth: 2 on a single
+        device, 1 + n_devices on a mesh so round-robin streaming keeps
+        every chip holding a window): windows w+1..w+N-1's signature
+        batches are in flight while the host applies window w's blocks
+        (sound within a constant-validator-set span: each window's
+        verification inputs — validator set and predecessor block id —
+        are known before w is applied; across a set change the pipeline
+        drains and re-queues with the post-apply state)."""
         stats = ReplayStats()
         t0 = time.perf_counter()
         tip = to_height or self.store.height()
         h = state.last_block_height + 1
         if self.verify_mode == "batched" and h <= tip:
+            from collections import deque
+
+            depth = self._pipeline_depth()
             cur_hash = state.validators.hash()
             blocks = self._load_window(h, tip, cur_hash)
             if not blocks:
@@ -305,54 +325,72 @@ class ReplayEngine:
                 state.chain_id, state.validators, state.last_validators,
                 state.last_block_id, state.initial_height, blocks,
             )
-            while blocks:
-                # start the (fixed ~100 ms through a tunnel) device->host
-                # fetch of this window's verdict now, so it rides under
-                # the next window's load + sign-bytes packing instead of
-                # blocking in _resolve_window
-                handle[0].prefetch()
-                nh = blocks[-1].header.height + 1
-                nxt = nxt_handle = None
-                if nh <= tip:
-                    # speculative: problems in window w+1's data must not
-                    # abort before the already-verified window w applies
-                    # (they resurface in the serial re-queue below, after
-                    # w's progress is durable)
+            q: deque = deque([(blocks, handle)])
+            last_qed = blocks  # last window queued (speculation anchor)
+            spec_dead = False  # stop speculating until the serial requeue
+
+            def fill():
+                # top the in-flight queue up to `depth` windows,
+                # speculatively: problems in a later window's data must
+                # not abort before the already-verified earlier windows
+                # apply (they resurface in the serial re-queue below,
+                # after that progress is durable)
+                nonlocal last_qed, spec_dead
+                while not spec_dead and len(q) < depth:
+                    nh = last_qed[-1].header.height + 1
+                    if nh > tip:
+                        return
                     try:
                         nxt = self._load_window(nh, tip, cur_hash)
-                        if nxt:
-                            # same-set continuation: queue before applying
-                            nxt_handle = self._queue_window(
-                                state.chain_id, state.validators,
-                                state.validators, block_id_for(blocks[-1]),
-                                state.initial_height, nxt,
-                            )
-                    except CommitError:
-                        nxt = nxt_handle = None
-                    except BlockValidationError:
-                        nxt = nxt_handle = None
+                        if not nxt:
+                            spec_dead = True
+                            return
+                        # same-set continuation: every window in the
+                        # span was signed by the CURRENT validator set
+                        nxt_handle = self._queue_window(
+                            state.chain_id, state.validators,
+                            state.validators, block_id_for(last_qed[-1]),
+                            state.initial_height, nxt,
+                        )
+                    except (CommitError, BlockValidationError):
+                        spec_dead = True
+                        return
+                    # start the (fixed ~100 ms through a tunnel)
+                    # device->host fetch early so it rides under later
+                    # queueing/apply work instead of blocking resolve
+                    nxt_handle[0].prefetch()
+                    q.append((nxt, nxt_handle))
+                    last_qed = nxt
+
+            while q:
+                fill()  # keep every device busy before blocking
+                blocks, handle = q.popleft()
+                handle[0].prefetch()
                 stats.sigs_verified += self._resolve_window(handle)
                 for block in blocks:
                     bid = block_id_for(block)
                     state = self.executor.apply_block_preverified(state, bid, block)
                     stats.blocks += 1
-                if nh > tip:
-                    break
-                if nxt_handle is None:
-                    # validator set changed at the boundary: reload and
-                    # queue against the post-apply state
-                    cur_hash = state.validators.hash()
-                    nxt = self._load_window(nh, tip, cur_hash)
-                    if not nxt:
-                        raise BlockValidationError(
-                            f"cannot form window at height {nh}"
-                        )
-                    nxt_handle = self._queue_window(
-                        state.chain_id, state.validators,
-                        state.last_validators, state.last_block_id,
-                        state.initial_height, nxt,
+                nh = blocks[-1].header.height + 1
+                if q or nh > tip:
+                    continue
+                # pipeline drained mid-chain: validator set changed at
+                # the boundary (or speculation failed) — reload and
+                # queue against the post-apply state
+                cur_hash = state.validators.hash()
+                spec_dead = False
+                nxt = self._load_window(nh, tip, cur_hash)
+                if not nxt:
+                    raise BlockValidationError(
+                        f"cannot form window at height {nh}"
                     )
-                blocks, handle = nxt, nxt_handle
+                nxt_handle = self._queue_window(
+                    state.chain_id, state.validators,
+                    state.last_validators, state.last_block_id,
+                    state.initial_height, nxt,
+                )
+                q.append((nxt, nxt_handle))
+                last_qed = nxt
             stats.elapsed_s = time.perf_counter() - t0
             return state, stats
         # "full" mode: reference-faithful per-height verify + apply

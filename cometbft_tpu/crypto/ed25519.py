@@ -203,7 +203,7 @@ def dispatch_model(n: int, b: int) -> dict:
         "device": n * _DEV_RLC_US * 1e-6,
         "host": n * host["rlc_us"] * 1e-6,
     }
-    return {
+    out = {
         "link_mbps": _LINK_MBPS,
         "host_terms": host,
         "ladder": ladder,
@@ -211,6 +211,26 @@ def dispatch_model(n: int, b: int) -> dict:
         "t_ladder": max(ladder.values()),
         "t_rlc": max(rlc.values()),
     }
+    eng = _mesh_engine()
+    if eng is not None and eng.n_devices > 1:
+        # Sharded-mesh term: the batch's device time splits d ways but
+        # the wire stage pays d separate shard stagings (each with the
+        # calibrated fixed per-transfer cost) and every launch pays one
+        # psum across the mesh. Host packing is the same 96 B/lane rsk
+        # pack as the ladder. The mesh wins exactly when the batch is
+        # device-bound — when wire or host binds, splitting device time
+        # buys nothing and the fixed costs make it a strict loss.
+        d = eng.n_devices
+        terms = eng.dispatch_terms()
+        mesh = {
+            "wire": _WIRE_LADDER_B * b / bw + d * terms["put_fixed_s"],
+            "device": n * _DEV_LADDER_US * 1e-6 / d + terms["collective_s"],
+            "host": ladder["host"],
+        }
+        out["mesh"] = mesh
+        out["t_mesh"] = max(mesh.values())
+        out["n_devices"] = d
+    return out
 
 
 def _rlc_beats_ladder(n: int, b: int) -> bool:
@@ -218,6 +238,18 @@ def _rlc_beats_ladder(n: int, b: int) -> bool:
     # sequential-resource stages: host packing, wire, device
     m = dispatch_model(n, b)
     return m["t_rlc"] < m["t_ladder"]
+
+
+def _mesh_beats_single(n: int, b: int) -> bool:
+    """Sharded mesh vs the best single-chip path (ladder, or RLC where
+    it applies): honest per-batch pick from the same stage model."""
+    m = dispatch_model(n, b)
+    if "t_mesh" not in m:
+        return False
+    best_single = m["t_ladder"]
+    if n >= RLC_MIN:
+        best_single = min(best_single, m["t_rlc"])
+    return m["t_mesh"] < best_single
 
 
 # Below this size the native C++ verifier wins: a commit-sized batch
@@ -260,6 +292,29 @@ def _native_limit(n: int) -> int:
     if limit and not _accel_backed():
         return n + 1
     return limit
+
+
+# At and above this size the sharded mesh path is considered: below it
+# the d separate per-shard H2D transfers (each paying the fixed staging
+# cost) eat the device-time split, and the single-chip ladder pipeline
+# already hides its wire under compute. Same order as RLC_MIN — both
+# engines only make sense at mega-batch sizes.
+MESH_MIN = 4096
+
+
+def _mesh_engine():
+    """The process-wide multi-device verify mesh, or None when the mesh
+    path is off (CPU-only jax, a single device, or COMETBFT_TPU_MESH=0
+    — parallel/mesh.get_engine owns the policy). Imported lazily: the
+    mesh module pulls in jax at import time and this module must stay
+    importable without it."""
+    try:
+        from ..parallel import mesh as _mesh
+
+        return _mesh.get_engine(accel_backed=_accel_backed())
+    except Exception:
+        return None
+
 
 # Minimum batch size for the structured-wire (delta) device path: below
 # this the detection overhead isn't worth it and the native engine has
@@ -502,6 +557,12 @@ class Ed25519BatchVerifier(BatchVerifier):
                 pending = self._native_batch()
                 if pending is not None:
                     path = "native"
+            if pending is None and n >= MESH_MIN:
+                eng = _mesh_engine()
+                if eng is not None and _mesh_beats_single(n, _bucket(n)):
+                    pending = self._launch_mesh(eng)
+                    if pending is not None:
+                        path = "mesh"
             if (pending is None and n >= RLC_MIN
                     and _rlc_beats_ladder(n, _bucket(n))):
                 pending = self._launch_rlc()
@@ -537,9 +598,15 @@ class Ed25519BatchVerifier(BatchVerifier):
         pending._t0 = t0
         if _trace.enabled:
             fields = {"path": path, "n": n}
-            if path in ("rlc", "ladder", "delta"):
+            if path in ("rlc", "ladder", "delta", "mesh"):
                 mdl = dispatch_model(n, _bucket(n))
-                stages = mdl["rlc"] if path == "rlc" else mdl["ladder"]
+                if path == "rlc":
+                    stages = mdl["rlc"]
+                elif path == "mesh" and "mesh" in mdl:
+                    stages = mdl["mesh"]
+                    fields["n_devices"] = mdl["n_devices"]
+                else:
+                    stages = mdl["ladder"]
                 fields.update(
                     model_host_ms=round(stages["host"] * 1e3, 3),
                     model_wire_ms=round(stages["wire"] * 1e3, 3),
@@ -683,7 +750,47 @@ class Ed25519BatchVerifier(BatchVerifier):
                 self._materialize()
                 self._device_path = "delta"
                 return self._launch_device_delta(self._delta)
-        pub_blob = self._pub_buf  # zero-copy; hashed + copied below only
+        rsk, live, pub_blob = self._pack_rsk_live(n, b)
+        # Streamed placement: when a multi-device mesh is up, each whole
+        # single-chip batch lands on the next device round-robin, so d
+        # independent commits verify concurrently with no collective at
+        # all; device_put is async, so H2D staging for device i+1
+        # overlaps compute on device i (double-buffered by the in-flight
+        # pipeline — submit()s queue, collect_pending fans in).
+        eng = _mesh_engine()
+        dev = None
+        if eng is not None and eng.n_devices > 1:
+            dev = eng.next_device()
+        # Device-resident pubkey cache: replay verifies the SAME validator
+        # set every height, so A ships + decompresses once per set change
+        # (keyed by content hash — 1 ms vs 50 ms of wire + exponentiation;
+        # streamed batches key per device so each chip keeps its own copy).
+        fp = (hashlib.sha256(pub_blob).digest(), b, dev)
+        cached = _A_CACHE.get(fp)
+        if cached is None:
+            a_bytes = np.zeros((b, 32), np.uint8)
+            a_bytes[:n] = np.frombuffer(pub_blob, np.uint8).reshape(n, 32)
+            cached = decompress_pubkeys_jit(jax.device_put(a_bytes, dev))
+            _A_CACHE[fp] = cached
+            while len(_A_CACHE) > _A_CACHE_SIZE:
+                _A_CACHE.pop(next(iter(_A_CACHE)))
+        ok_a, neg_a = cached
+        global _LAST_WIRE_B_PER_LANE
+        _LAST_WIRE_B_PER_LANE = _WIRE_LADDER_B
+        if dev is not None and _trace.enabled:
+            _trace.emit("crypto.stream_place", "event",
+                        device=str(getattr(dev, "id", dev)), n=n, b=b)
+        return verify_batch_cached_a_jit(
+            ok_a, neg_a, *jax.device_put((rsk, live), dev)
+        )
+
+    def _pack_rsk_live(self, n: int, b: int):
+        """Pack the (b,96) R||S||k rows + live mask shared by the
+        single-chip prehashed ladder and the sharded mesh paths (k
+        hashed host-side; see _launch_device's docstring)."""
+        import hashlib
+
+        pub_blob = self._pub_buf  # zero-copy; hashed + copied by callers
         rsk = np.zeros((b, 96), np.uint8)
         live = np.zeros((b,), bool)
         live[:n] = True
@@ -709,23 +816,31 @@ class Ed25519BatchVerifier(BatchVerifier):
                 for pub, msg, sig in self._items
             )
             rsk[:n, 64:] = np.frombuffer(ks, np.uint8).reshape(n, 32)
-        # Device-resident pubkey cache: replay verifies the SAME validator
-        # set every height, so A ships + decompresses once per set change
-        # (keyed by content hash — 1 ms vs 50 ms of wire + exponentiation).
-        fp = (hashlib.sha256(pub_blob).digest(), b)
-        cached = _A_CACHE.get(fp)
-        if cached is None:
-            a_bytes = np.zeros((b, 32), np.uint8)
-            a_bytes[:n] = np.frombuffer(pub_blob, np.uint8).reshape(n, 32)
-            cached = decompress_pubkeys_jit(jax.device_put(a_bytes))
-            _A_CACHE[fp] = cached
-            while len(_A_CACHE) > _A_CACHE_SIZE:
-                _A_CACHE.pop(next(iter(_A_CACHE)))
-        ok_a, neg_a = cached
+        return rsk, live, pub_blob
+
+    def _launch_mesh(self, eng):
+        """Shard one mega-batch over every mesh device: same 96 B/lane
+        prehashed wire as the ladder path, padded so B divides the mesh
+        (dead lanes ride live=False and are masked from the psum), with
+        the pubkey column staged once per validator set in the engine's
+        sharded cache. Returns a PendingBatch over the un-fetched
+        replicated all-ok scalar + sharded bitmap."""
+        import hashlib
+
+        from ..parallel.mesh import pad_to_shards
+
+        n = self.count()
+        b = pad_to_shards(n, eng.n_devices, bucket=_bucket(n))
+        rsk, live, pub_blob = self._pack_rsk_live(n, b)
+        a_bytes = np.zeros((b, 32), np.uint8)
+        a_bytes[:n] = np.frombuffer(bytes(pub_blob), np.uint8).reshape(n, 32)
+        fp = hashlib.sha256(bytes(pub_blob)).digest()
         global _LAST_WIRE_B_PER_LANE
         _LAST_WIRE_B_PER_LANE = _WIRE_LADDER_B
-        return verify_batch_cached_a_jit(
-            ok_a, neg_a, *jax.device_put((rsk, live))
+        all_ok, bits = eng.submit(a_bytes, rsk, live, fp=fp)
+        self._device_path = "mesh"
+        return PendingBatch(
+            bits, all_ok, n, list(self._precheck_fail), [], []
         )
 
     def _launch_device_delta(self, d):
@@ -1006,7 +1121,19 @@ def collect_pending(pendings: list[PendingBatch]) -> list[tuple[bool, list[bool]
 
     if not pendings:
         return []
-    summaries = np.asarray(jnp.stack([p._all_ok for p in pendings]))
+    try:
+        summaries = np.asarray(jnp.stack([p._all_ok for p in pendings]))
+    except ValueError:
+        # Streamed batches live on different mesh devices — jnp.stack
+        # refuses committed arrays on conflicting devices. Fan in by
+        # starting every D2H copy async first, then fetching: the
+        # transfers overlap across chips, so the wall cost stays one
+        # round trip, not one per device.
+        for p in pendings:
+            p.prefetch()
+        summaries = np.asarray(
+            [np.asarray(p._all_ok) for p in pendings]
+        )
     return [p._finalize_fast(bool(s)) for p, s in zip(pendings, summaries)]
 
 

@@ -13,10 +13,17 @@ collectives.
 
 from __future__ import annotations
 
+import hashlib
+import os
+import time as _time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import trace as _trace
+from ..utils.metrics import crypto_metrics
 
 try:  # jax >= 0.5: top-level export, replication check kwarg is check_vma
     from jax import shard_map as _shard_map
@@ -87,3 +94,271 @@ def sharded_verify_fn_2d(mesh: Mesh):
     across every chip of every host, hierarchical reduction (see
     sharded_verify_fn)."""
     return sharded_verify_fn(mesh, axes=("host", "sig"))
+
+
+def pad_to_shards(n: int, parts: int, bucket: int | None = None) -> int:
+    """Smallest padded batch size that (a) holds n lanes, (b) is at
+    least the pre-bucketed size (so mesh submits reuse the bucket-tier
+    compile discipline), and (c) divides evenly over `parts` shards.
+
+    Handles every mesh-boundary edge case: n < parts (every device
+    still gets an equal, partially-dead shard), prime n, and n == 0
+    (one all-dead shard per device so the compiled graph shape holds).
+    Dead lanes ride with live=False and are masked out of the psum.
+    """
+    b = max(int(bucket or 0), int(n), 1)
+    return -(-b // parts) * parts
+
+
+def sharded_verify_rsk_fn(mesh: Mesh, axes: str | tuple[str, ...] = "sig"):
+    """The production mesh verifier: prehashed 96-byte R||S||k lanes.
+
+    Inputs: a_bytes (B,32)u8 pubkey encodings, rsk (B,96)u8 packed
+    R||S||k rows (k = SHA-512(R||A||M) mod L hashed host-side — the
+    same wire diet the single-chip ladder path won with), live (B,)
+    bool. B must divide by the product of the named mesh axes
+    (pad_to_shards). Pubkey decompression runs in-shard so the staged
+    a_bytes can stay device-resident across submits (engine cache).
+
+    Returns (all_ok scalar replicated, bits (B,) sharded). The
+    invalid-lane count psums innermost-axis-first: on a hierarchical
+    (host, sig) mesh partial sums ride ICI within each host and one
+    scalar per host crosses DCN.
+    """
+    axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+
+    def local(a, rsk, live):
+        bits, _ = ed25519_verify.verify_batch_prehashed(
+            a, rsk[:, :32], rsk[:, 32:64], rsk[:, 64:], live
+        )
+        bad = jnp.sum((~bits & live).astype(jnp.int32))
+        for ax in reversed(axes_t):  # innermost (fast) axis first
+            bad = jax.lax.psum(bad, ax)
+        return bad == 0, bits
+
+    spec_b = P(axes_t if len(axes_t) > 1 else axes_t[0])
+    fn = _shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec_b,) * 3,
+        out_specs=(P(), spec_b),
+        **{_CHECK_KW: False},
+    )
+    return jax.jit(fn)
+
+
+# Dispatch-term fallbacks when calibration is skipped
+# (COMETBFT_TPU_DISPATCH_CALIBRATE=0) or fails. put_fixed: each shard's
+# H2D staging pays a fixed per-transfer cost on top of the bytes (the
+# same fixed cost the single-chip path's array-packing work avoids —
+# measured ~100 ms/transfer through a tunneled runtime, ~100 us on a
+# local PCIe-class link; the local figure is the fallback since a mesh
+# implies local chips). collective: one psum across the mesh per launch
+# (ICI hop latency class, not bandwidth).
+_PUT_FIXED_US_FALLBACK = 100.0
+_COLLECTIVE_US_FALLBACK = 60.0
+
+_A_CACHE_SIZE = 4
+
+
+class MeshVerifyEngine:
+    """Owns a device mesh and the compiled sharded verifiers for it.
+
+    Two serving modes, both driven from ed25519's dispatch:
+
+    - submit(): ONE mega-batch sharded over every device (batch axis =
+      'sig'; on multi-process pods the outer 'host' axis keeps the psum
+      hierarchical). Used when a single batch is big enough that
+      splitting its device time d ways beats one chip.
+    - next_device(): round-robin placement for *independent* batches
+      (streamed commits): each whole batch lands on one chip, so d
+      commits verify concurrently with no collective at all. The
+      caller's in-flight pipeline (submit()/collect_pending) is the
+      per-device queue; H2D staging for device i+1 overlaps compute on
+      device i because device_put is async.
+    """
+
+    def __init__(self, devices=None, hosts: int | None = None,
+                 calibrate: bool | None = None):
+        devices = list(devices if devices is not None else jax.devices())
+        if not devices:
+            raise ValueError("mesh engine needs at least one device")
+        self.devices = devices
+        self.n_devices = len(devices)
+        if hosts is None:
+            nproc = getattr(jax, "process_count", lambda: 1)()
+            hosts = nproc if nproc > 1 and self.n_devices % nproc == 0 else 1
+        if hosts > 1:
+            self.axes = ("host", "sig")
+            self.mesh = Mesh(
+                np.asarray(devices).reshape(hosts, self.n_devices // hosts),
+                self.axes,
+            )
+        else:
+            self.axes = ("sig",)
+            self.mesh = Mesh(np.asarray(devices), self.axes)
+        self._spec = P(self.axes if len(self.axes) > 1 else self.axes[0])
+        self._sharding = NamedSharding(self.mesh, self._spec)
+        self._fns: dict[int, object] = {}  # padded B -> compiled verifier
+        self._a_cache: dict = {}  # (sha256(pub col), B) -> staged a_bytes
+        self._rr = 0
+        self._terms: dict | None = None
+        if calibrate is None:
+            calibrate = os.environ.get(
+                "COMETBFT_TPU_DISPATCH_CALIBRATE", "1") != "0"
+        self._want_calibrate = calibrate
+        crypto_metrics().mesh_devices.set(float(self.n_devices))
+
+    # -- dispatch terms ------------------------------------------------
+
+    def dispatch_terms(self) -> dict:
+        """{'put_fixed_s', 'collective_s', 'calibrated'} for
+        dispatch_model's mesh entry; the H2D fixed cost is measured on
+        THIS runtime at first use (one tiny staged transfer — no kernel
+        compile, so first dispatch stays cheap), the collective term is
+        the documented fallback until a bench refines it via
+        set_collective_s()."""
+        if self._terms is None:
+            terms = {
+                "put_fixed_s": _PUT_FIXED_US_FALLBACK * 1e-6,
+                "collective_s": _COLLECTIVE_US_FALLBACK * 1e-6,
+                "calibrated": False,
+            }
+            if self._want_calibrate:
+                try:
+                    buf = np.zeros((self.n_devices * 64, 96), np.uint8)
+                    jax.block_until_ready(
+                        jax.device_put(buf, self._sharding))  # warm path
+                    best = float("inf")
+                    for _ in range(2):
+                        t0 = _time.perf_counter()
+                        jax.block_until_ready(
+                            jax.device_put(buf, self._sharding))
+                        best = min(best, _time.perf_counter() - t0)
+                    # per-device share of the fixed staging cost
+                    terms["put_fixed_s"] = best / self.n_devices
+                    terms["calibrated"] = True
+                except Exception:
+                    pass
+            self._terms = terms
+        return self._terms
+
+    def set_collective_s(self, seconds: float) -> None:
+        """Refine the collective-latency term from a measured sharded
+        run (bench/workloads feed this back)."""
+        self.dispatch_terms()["collective_s"] = max(float(seconds), 0.0)
+
+    # -- sharded mega-batch path ---------------------------------------
+
+    def _fn(self, b: int):
+        fn = self._fns.get(b)
+        if fn is None:
+            fn = self._fns[b] = sharded_verify_rsk_fn(self.mesh, self.axes)
+        return fn
+
+    def stage_pubkeys(self, a_bytes: np.ndarray, fp=None):
+        """Device-put the (B,32) pubkey column with the batch sharding,
+        cached by content hash: replay verifies the SAME validator set
+        every height, so its 32 B/lane never re-cross the host link
+        (decompression itself runs in-shard each submit — cheaper to
+        recompute than to keep a limb-layout pytree cached per mesh)."""
+        b = a_bytes.shape[0]
+        if fp is None:
+            fp = hashlib.sha256(a_bytes.tobytes()).digest()
+        key = (fp, b)
+        staged = self._a_cache.get(key)
+        if staged is None:
+            staged = jax.device_put(a_bytes, self._sharding)
+            self._a_cache[key] = staged
+            while len(self._a_cache) > _A_CACHE_SIZE:
+                self._a_cache.pop(next(iter(self._a_cache)))
+        return staged
+
+    def submit(self, a_bytes: np.ndarray, rsk: np.ndarray,
+               live: np.ndarray, fp=None):
+        """Launch one sharded verify; returns un-fetched device arrays
+        (all_ok scalar, bits (B,)). B = a_bytes.shape[0] must be a
+        pad_to_shards() multiple of n_devices; dead lanes carry
+        live=False and are masked from the psum."""
+        b = a_bytes.shape[0]
+        if b % self.n_devices:
+            raise ValueError(
+                f"batch {b} does not shard over {self.n_devices} devices "
+                "(pad with pad_to_shards)"
+            )
+        t0 = _time.perf_counter()
+        a_dev = self.stage_pubkeys(a_bytes, fp=fp)
+        rsk_dev, live_dev = jax.device_put((rsk, live), self._sharding)
+        all_ok, bits = self._fn(b)(a_dev, rsk_dev, live_dev)
+        m = crypto_metrics()
+        for i in range(self.n_devices):
+            m.mesh_batches_total.inc(1.0, str(i), "shard")
+        if _trace.enabled:
+            _trace.emit(
+                "crypto.mesh_submit", "span",
+                dur_ms=round((_time.perf_counter() - t0) * 1e3, 3),
+                n=int(live.sum()), b=b, n_devices=self.n_devices,
+                shard_lanes=b // self.n_devices,
+            )
+        return all_ok, bits
+
+    # -- streamed independent-batch path -------------------------------
+
+    def next_device(self):
+        """Round-robin target for the next independent (streamed) batch;
+        the per-device counter is the flight recorder's skew signal."""
+        i = self._rr % self.n_devices
+        self._rr += 1
+        crypto_metrics().mesh_batches_total.inc(1.0, str(i), "stream")
+        return self.devices[i]
+
+
+_ENGINE = None
+_ENGINE_PROBED = False
+
+
+def get_engine(accel_backed: bool = True):
+    """Process-wide engine, or None when the mesh path is off.
+
+    Policy (COMETBFT_TPU_MESH):
+      - "0"/"off": disabled.
+      - unset: auto — enabled when a real accelerator backs jax AND
+        more than one device exists (on CPU-only hosts the native
+        engine dominates every device path, so virtual-device meshes
+        never capture production batches by default).
+      - "1"/"on"/"auto": enabled over every device (the bench/test seam
+        for the virtual CPU mesh).
+      - N >= 2: enabled over the first N devices.
+    """
+    global _ENGINE, _ENGINE_PROBED
+    if _ENGINE_PROBED:
+        return _ENGINE
+    env = os.environ.get("COMETBFT_TPU_MESH", "").strip().lower()
+    engine = None
+    try:
+        if env in ("0", "off"):
+            engine = None
+        elif env in ("", None):
+            if accel_backed and len(jax.devices()) > 1:
+                engine = MeshVerifyEngine()
+        elif env in ("1", "on", "auto"):
+            if len(jax.devices()) > 1:
+                engine = MeshVerifyEngine()
+        else:
+            n = int(env)
+            devs = jax.devices()
+            if n >= 2 and len(devs) >= 2:
+                engine = MeshVerifyEngine(devs[: min(n, len(devs))])
+    except Exception:
+        engine = None
+    _ENGINE = engine
+    _ENGINE_PROBED = True
+    return _ENGINE
+
+
+def reset_engine() -> None:
+    """Test seam: drop the cached engine so the next get_engine() call
+    re-reads the environment."""
+    global _ENGINE, _ENGINE_PROBED
+    _ENGINE = None
+    _ENGINE_PROBED = False

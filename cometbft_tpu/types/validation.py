@@ -106,27 +106,25 @@ def _verify_items(items, backend: str):
             elif not added:
                 # rejected outright: decide singly
                 singles.setdefault(tag, []).append(i)
+        # Launch every batch group async FIRST (submit() returns an
+        # in-flight handle; on a multi-device mesh each group can land
+        # on a different chip), then verify the singles while the
+        # batches are on device, then resolve. Raise ordering is
+        # PRESERVED exactly: batch groups resolve and raise in group
+        # insertion order before any single verdict raises, which is
+        # what the serial code did — the singles' verdicts are computed
+        # early but deferred.
+        in_flight = []
         for tag, (bv, idxs) in groups.items():
             if bv is None or not idxs:
                 continue
             t0 = _time.perf_counter()
-            ok, bits = bv.verify()
-            _observe_partition(tag, "batch", len(idxs),
-                               _time.perf_counter() - t0)
-            if ok:
-                continue
-            if bits:
-                # device bitmap pinpoints failures directly — no rescan
-                for j, b in zip(idxs, bits):
-                    if not b:
-                        raise ErrInvalidSignature(f"invalid signature at index {j}")
-            # batch could not localize: fall back to single verification
-            # like the reference (:327). If every signature passes singly,
-            # the commit is valid — accept.
-            for j in idxs:
-                pub, msg, sig, _ = items[j]
-                if not pub.verify_signature(msg, sig):
-                    raise ErrInvalidSignature(f"invalid signature at index {j}")
+            pending = None
+            if backend != "cpu" and hasattr(bv, "submit"):
+                pending = bv.submit()
+                pending.prefetch()
+            in_flight.append((tag, bv, idxs, t0, pending))
+        deferred = []
         for tag, idxs in singles.items():
             t0 = _time.perf_counter()
             if tag == _SECP_TAG:
@@ -150,6 +148,29 @@ def _verify_items(items, backend: str):
                     items[i][1], items[i][2]) for i in idxs]
             _observe_partition(tag, path, len(idxs),
                                _time.perf_counter() - t0)
+            deferred.append((idxs, verdicts))
+        for tag, bv, idxs, t0, pending in in_flight:
+            if pending is not None:
+                ok, bits = pending.result()
+            else:
+                ok, bits = bv.verify()
+            _observe_partition(tag, "batch", len(idxs),
+                               _time.perf_counter() - t0)
+            if ok:
+                continue
+            if bits:
+                # device bitmap pinpoints failures directly — no rescan
+                for j, b in zip(idxs, bits):
+                    if not b:
+                        raise ErrInvalidSignature(f"invalid signature at index {j}")
+            # batch could not localize: fall back to single verification
+            # like the reference (:327). If every signature passes singly,
+            # the commit is valid — accept.
+            for j in idxs:
+                pub, msg, sig, _ = items[j]
+                if not pub.verify_signature(msg, sig):
+                    raise ErrInvalidSignature(f"invalid signature at index {j}")
+        for idxs, verdicts in deferred:
             for i, ok in zip(idxs, verdicts):
                 if not ok:
                     raise ErrInvalidSignature(f"invalid signature at index {i}")

@@ -338,6 +338,14 @@ class CryptoMetrics:
         self.calibration_us_per_sig = reg.gauge(
             "crypto", "calibration_us_per_sig",
             "Calibrated host-stage dispatch terms", labels=("term",))
+        self.mesh_devices = reg.gauge(
+            "crypto", "mesh_devices",
+            "Device count of the active verify mesh (0/absent = mesh off)")
+        self.mesh_batches_total = reg.counter(
+            "crypto", "mesh_batches_total",
+            "Batches placed per mesh device: sharded mega-batch shards "
+            "and streamed whole-commit placements (skew attribution)",
+            labels=("device", "mode"))
 
 
 _BUNDLES: dict[str, object] = {}

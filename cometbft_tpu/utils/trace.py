@@ -267,6 +267,8 @@ SPAN_REGISTRY = {
     "blocksync.block": "one fast-synced block: fetch→verify→apply breakdown",
     "crypto.batch_verify": "one batch-verify dispatch: path, n, modeled host/wire/device terms",
     "crypto.commit_partition": "per-curve share of one commit verification",
+    "crypto.mesh_submit": "one sharded mega-batch across the verify mesh (n/b/n_devices/shard_lanes)",
+    "crypto.stream_place": "one streamed commit placed on a mesh device (device/n/b)",
     "p2p.send": "consensus wire message handed to a peer (msg/height/round/peer)",
     "p2p.recv": "consensus wire message received from a peer (msg/height/round/peer)",
 }

@@ -427,3 +427,92 @@ def test_rlc_stream_length_is_tiered():
         assert len(prep["stream_neg"]) * 8 >= len(prep["stream"])
         lengths.add(len(prep["stream"]))
     assert len(lengths) == 1, "same-size batches must share one tier"
+
+
+# -- mesh dispatch term (PR 7) ---------------------------------------------
+
+
+class _StubMesh:
+    """dispatch_terms()-shaped stand-in so the crossover is pinned by
+    arithmetic, not by what hardware backs this test run."""
+
+    n_devices = 8
+
+    def __init__(self, put_fixed_s=100e-6, collective_s=60e-6):
+        self._t = {
+            "put_fixed_s": put_fixed_s,
+            "collective_s": collective_s,
+            "calibrated": True,
+        }
+
+    def dispatch_terms(self):
+        return self._t
+
+
+def test_mesh_term_absent_without_engine(monkeypatch):
+    e = _pin_model(monkeypatch, link_mbps=1000.0, rlc_us=1.1)
+    monkeypatch.setattr(e, "_mesh_engine", lambda: None)
+    m = e.dispatch_model(10000, 10240)
+    assert "mesh" not in m and "t_mesh" not in m
+    assert not e._mesh_beats_single(10000, 10240)
+
+
+def test_mesh_flips_device_bound_batch(monkeypatch):
+    """Fast link, 8 chips: the ladder's 23.9 ms device stage splits to
+    ~3 ms and the mesh becomes HOST-bound at 16 ms — below both ladder
+    (23.9 device) and RLC (21.1 device), so dispatch must flip to mesh
+    exactly where splitting device time is what the batch needed."""
+    e = _pin_model(monkeypatch, link_mbps=1000.0, rlc_us=1.1)
+    monkeypatch.setattr(e, "_mesh_engine", lambda: _StubMesh())
+    m = e.dispatch_model(10000, 10240)
+    assert m["n_devices"] == 8
+    assert m["mesh"]["device"] == pytest.approx(
+        10000 * e._DEV_LADDER_US * 1e-6 / 8 + 60e-6)
+    assert m["t_mesh"] == pytest.approx(10000 * 1.6e-6)  # host binds
+    assert e._mesh_beats_single(10000, 10240)
+
+
+def test_mesh_never_wins_wire_bound(monkeypatch):
+    """Tunneled link (30 MB/s): the mesh ships the same 96 B/lane PLUS
+    d fixed shard stagings, so its wire stage strictly exceeds the
+    ladder's binding wire stage — splitting device time buys nothing
+    and dispatch must keep the single chip."""
+    e = _pin_model(monkeypatch, link_mbps=30.0, rlc_us=1.1)
+    monkeypatch.setattr(e, "_mesh_engine", lambda: _StubMesh())
+    m = e.dispatch_model(10000, 10240)
+    assert m["mesh"]["wire"] > m["ladder"]["wire"]
+    assert m["t_ladder"] == pytest.approx(m["ladder"]["wire"])  # wire-bound
+    assert not e._mesh_beats_single(10000, 10240)
+
+
+def test_mesh_loses_on_expensive_staging(monkeypatch):
+    """100 ms fixed cost per shard device_put (tunneled-runtime class):
+    8 stagings = 0.8 s of wire overhead — the calibrated put term must
+    keep the mesh off even on a device-bound batch."""
+    e = _pin_model(monkeypatch, link_mbps=1000.0, rlc_us=1.1)
+    monkeypatch.setattr(e, "_mesh_engine", lambda: _StubMesh(put_fixed_s=0.1))
+    m = e.dispatch_model(10000, 10240)
+    assert m["t_mesh"] >= 0.8
+    assert not e._mesh_beats_single(10000, 10240)
+
+
+@needs_native
+def test_mesh_min_gates_submit(monkeypatch):
+    """Below MESH_MIN submit() must not even consult the mesh model:
+    commit-sized batches stay on the single-chip/native paths."""
+    from cometbft_tpu.crypto import ed25519 as e
+
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return None
+
+    monkeypatch.setattr(e, "_mesh_engine", probe)
+    monkeypatch.setattr(e, "NATIVE_MAX", 1024)
+    items = _signed(8)
+    bv = e.Ed25519BatchVerifier(backend="tpu")
+    for p, m_, s in items:
+        bv.add(e.Ed25519PubKey(p), m_, s)
+    bv.submit().result()
+    assert not calls

@@ -16,6 +16,12 @@ from cometbft_tpu.e2e import Manifest, Runner
 _CORES = os.cpu_count() or 1
 
 
+@pytest.mark.skipif(
+    _CORES < 2,
+    reason=f"4-node subprocess net with kill/pause/restart perturbations "
+           f"starves the scheduler on a single core and times out at "
+           f"height ~8 with messages still flowing (host has {_CORES})",
+)
 def test_e2e_perturbed_testnet(tmp_path):
     m = Manifest.parse({
         "chain_id": "e2e-chain",

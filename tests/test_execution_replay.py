@@ -125,3 +125,46 @@ def test_kvstore_app_query_and_validator_txs():
         FinalizeBlockRequest(txs=[b"val:" + pk_hex.encode() + b"=7"], height=2)
     )
     assert resp.validator_updates and resp.validator_updates[0].power == 7
+
+
+def test_pipeline_depth_policy(monkeypatch):
+    """Depth auto-selection: 2 on a single device, 1 + n_devices on a
+    mesh (every chip holds a window), explicit depth always wins."""
+    from cometbft_tpu.crypto import ed25519 as e
+
+    store = BlockStore(MemKV())
+    executor = BlockExecutor(AppConns(KVStoreApp()), backend="cpu")
+    engine = ReplayEngine(store, executor, backend="cpu")
+    monkeypatch.setattr(e, "_mesh_engine", lambda: None)
+    assert engine._pipeline_depth() == 2
+
+    class _Stub:
+        n_devices = 8
+
+    monkeypatch.setattr(e, "_mesh_engine", lambda: _Stub())
+    assert engine._pipeline_depth() == 9
+    deep = ReplayEngine(store, executor, backend="cpu", depth=4)
+    assert deep._pipeline_depth() == 4
+    monkeypatch.setattr(e, "_mesh_engine", lambda: None)
+    assert deep._pipeline_depth() == 4
+
+
+def test_replay_deep_pipeline_matches(chain):
+    """Depth-4 over 2-block windows: the speculative fill walks several
+    windows ahead of the apply loop and past the tip; the final state
+    must be byte-identical to the depth-1 (serial) run."""
+    store, final_state, genesis, _ = chain
+    runs = []
+    for depth in (1, 4):
+        executor = BlockExecutor(AppConns(KVStoreApp()), backend="cpu")
+        engine = ReplayEngine(
+            store, executor, verify_mode="batched", window=2,
+            backend="cpu", depth=depth,
+        )
+        state, stats = engine.run(genesis.copy())
+        assert stats.blocks == 8
+        runs.append((state, stats))
+    (a, sa), (b, sb) = runs
+    assert sa.sigs_verified == sb.sigs_verified > 0  # depth never changes lanes
+    assert a.app_hash == b.app_hash == final_state.app_hash
+    assert a.last_block_height == b.last_block_height == 8

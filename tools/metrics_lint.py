@@ -28,7 +28,8 @@ MUTATORS = ("inc", "set", "add", "observe")
 
 
 def _bundle_metrics():
-    """{bundle_class_name: [attr, ...]} for every *Metrics bundle."""
+    """{bundle_class_name: [(attr, n_labels), ...]} for every *Metrics
+    bundle."""
     sys.path.insert(0, REPO)
     from cometbft_tpu.utils import metrics as M
 
@@ -41,7 +42,7 @@ def _bundle_metrics():
             continue
         bundle = cls(M.Registry())
         attrs = [
-            a for a, v in vars(bundle).items()
+            (a, len(v.labels)) for a, v in vars(bundle).items()
             if isinstance(v, M._Metric)
         ]
         if attrs:
@@ -72,19 +73,49 @@ def main() -> int:
     bundles = _bundle_metrics()
     src = _package_sources()
     dead: list[str] = []
+    unlabeled: list[str] = []
     for bundle, attrs in sorted(bundles.items()):
-        for attr in attrs:
+        for attr, n_labels in attrs:
             pat = re.compile(
                 r"\." + re.escape(attr) + r"\.(?:" + "|".join(MUTATORS)
                 + r")\("
             )
             if not pat.search(src):
                 dead.append(f"{bundle}.{attr}")
+                continue
+            if not n_labels:
+                continue
+            # Labeled metrics (e.g. the per-device mesh counters) must
+            # pass label values at every mutation site: a bare
+            # `.inc(1.0)` on a labeled counter raises at runtime, but
+            # only on the code path that hits it — catch it here
+            # instead. Only single-line calls with no nested parens are
+            # parseable by regex; sites that span lines or compute args
+            # are skipped (lenient: the lint flags the metric only when
+            # EVERY parseable site lacks a label argument).
+            site_pat = re.compile(
+                r"\." + re.escape(attr) + r"\.(?:" + "|".join(MUTATORS)
+                + r")\(([^()\n]*)\)"
+            )
+            sites = site_pat.findall(src)
+            if sites and not any("," in s for s in sites):
+                unlabeled.append(
+                    f"{bundle}.{attr} ({n_labels} labels)"
+                )
+    rc = 0
     if dead:
         print("dead metrics (registered but never driven):", file=sys.stderr)
         for d in dead:
             print(f"  {d}", file=sys.stderr)
-        return 1
+        rc = 1
+    if unlabeled:
+        print("labeled metrics driven without label values:",
+              file=sys.stderr)
+        for d in unlabeled:
+            print(f"  {d}", file=sys.stderr)
+        rc = 1
+    if rc:
+        return rc
     total = sum(len(a) for a in bundles.values())
     print(f"metrics lint: {total} metrics across {len(bundles)} bundles, "
           "all driven")

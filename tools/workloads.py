@@ -332,7 +332,323 @@ def bench_megacommit_mixed(n_vals=10_000, n_sr=1000, n_secp=500, reps=5):
     return rec
 
 
+def _emit(rec):
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _spawn_child(args, env_extra, timeout=3600):
+    """Run this script as a child with a controlled jax environment and
+    return its last JSON stdout line. Subprocesses are mandatory here:
+    XLA's device count is fixed at process start, so each n_devices
+    point needs its own interpreter."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(env_extra)
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        capture_output=True, text=True, env=env, timeout=timeout,
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"child {args} rc={p.returncode}\n"
+            f"stderr: {p.stderr[-2000:]}\nstdout: {p.stdout[-2000:]}"
+        )
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"child {args} produced no JSON: {p.stdout[-500:]}")
+
+
+def _accel_devices() -> int:
+    """Real accelerator device count (0 on CPU-only jax)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return 0
+    return len(jax.devices())
+
+
+def multichip_child(n_devices: int, batch: int = 1024):
+    """One sharded-verify measurement at a fixed device count: build a
+    signed batch through the production packing (Ed25519BatchVerifier
+    rsk pack), shard it over the mesh, and time submit→fetch."""
+    import jax
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519 as E
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.parallel.mesh import MeshVerifyEngine, pad_to_shards
+
+    devs = jax.devices()[:n_devices]
+    assert len(devs) == n_devices, f"need {n_devices} devices, have {len(devs)}"
+    eng = MeshVerifyEngine(devs)
+    seeds = [bytes([i + 1]) * 32 for i in range(4)]
+    pubs = [ref.pubkey_from_seed(s) for s in seeds]
+    msgs = [b"multichip-%d" % i for i in range(4)]
+    sigs = [ref.sign(seeds[i], msgs[i]) for i in range(4)]
+    bv = E.Ed25519BatchVerifier()
+    for i in range(batch):
+        j = i % 4
+        bv.add(E.Ed25519PubKey(pubs[j]), msgs[j], sigs[j])
+    n = bv.count()
+    b = pad_to_shards(n, eng.n_devices, bucket=E._bucket(n))
+    rsk, live, pub_blob = bv._pack_rsk_live(n, b)
+    a_bytes = np.zeros((b, 32), np.uint8)
+    a_bytes[:n] = np.frombuffer(bytes(pub_blob), np.uint8).reshape(n, 32)
+    all_ok, _ = eng.submit(a_bytes, rsk, live)  # warmup: compile + stage
+    assert bool(np.asarray(all_ok)), "warmup batch must verify"
+
+    def timed():
+        t0 = time.perf_counter()
+        ok, _bits = eng.submit(a_bytes, rsk, live)
+        ok = bool(np.asarray(ok))
+        d = time.perf_counter() - t0
+        assert ok
+        return d
+
+    dt, stat = _best_of(timed)
+    return {
+        "n_devices": n_devices,
+        "batch": n,
+        "padded": b,
+        "shard_lanes": b // n_devices,
+        "ms": round(dt * 1e3, 2),
+        "stat": stat,
+        "sigs_per_sec": round(n / dt, 1),
+        "put_fixed_us": round(
+            eng.dispatch_terms()["put_fixed_s"] * 1e6, 2),
+    }
+
+
+def bench_multichip(points=(1, 2, 4, 8), batch=1024):
+    """Real sharded multichip record -> MULTICHIP_r06.json: aggregate
+    sigs/s per device count plus scaling efficiency. On a host without
+    a real multi-device accelerator the mesh is XLA's virtual CPU
+    devices — every "chip" shares this host's physical cores, so the
+    speedup gate is recorded as skipped (asserting near-linear scaling
+    on a time-sliced mesh would gate on scheduler noise, not on the
+    sharded path); on a real pod the gate asserts >=1.7x at 2 chips."""
+    real = _accel_devices()
+    emulated = real < 2
+    per = {}
+    for nd in points:
+        env = {}
+        if emulated:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={nd}"
+            )
+        elif nd > real:
+            break
+        per[str(nd)] = _spawn_child(
+            ["--multichip-child", str(nd), str(batch)], env)
+        print(f"  multichip n_devices={nd}: "
+              f"{per[str(nd)]['sigs_per_sec']} sigs/s", file=sys.stderr)
+    base = per["1"]["sigs_per_sec"]
+    eff = {
+        nd: round(r["sigs_per_sec"] / (int(nd) * base), 3)
+        for nd, r in per.items()
+    }
+    gate = {"min_speedup_2dev": 1.7}
+    if emulated:
+        gate["asserted"] = False
+        gate["reason"] = (
+            "emulated mesh: XLA virtual CPU devices time-share this "
+            "host's cores, so aggregate throughput cannot scale with "
+            "device count; the gate needs >=2 real accelerator devices"
+        )
+    else:
+        gate["asserted"] = True
+        speedup = per["2"]["sigs_per_sec"] / base
+        gate["speedup_2dev"] = round(speedup, 3)
+        assert speedup >= 1.7, (
+            f"sharded verify speedup at 2 devices {speedup:.2f}x < 1.7x"
+        )
+    rec = {
+        "mode": "sharded_verify_rsk",
+        "batch": batch,
+        "emulated_cpu_mesh": emulated,
+        "per_n_devices": per,
+        "scaling_efficiency": eff,
+        "gate": gate,
+    }
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    return rec
+
+
+def two_backend_child(to_height: int = 16, window: int = 4):
+    """Device/mesh leg of the two-backend replay: same chain, same
+    ReplayEngine, but dispatch FORCED onto the sharded mesh path
+    (NATIVE_MAX=0 + always-mesh) so the measurement is the device
+    pipeline, not whatever dispatch would honestly pick here."""
+    import numpy as np  # noqa: F401  (jax warmup ordering)
+
+    from cometbft_tpu.abci.client import AppConns
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.blocksync import ReplayEngine
+    from cometbft_tpu.crypto import ed25519 as E
+    from cometbft_tpu.state.execution import BlockExecutor, make_genesis_state
+    from cometbft_tpu.storage import BlockStore, open_kv
+    from cometbft_tpu.utils import factories as fx
+
+    E.NATIVE_MAX = 0
+    E.MESH_MIN = 0
+    E._mesh_beats_single = lambda n, b: True
+    db_path = os.path.join("/tmp/ns_chain", "blockstore_2000b_1000v.db")
+    store = BlockStore(open_kv(db_path))
+    assert store.height() >= to_height, "run the CPU leg first (generates)"
+    signers = fx.make_signers(1000)
+    vals = fx.make_validator_set(signers)
+    genesis = make_genesis_state("ns-chain", vals)
+
+    def one_run():
+        executor = BlockExecutor(AppConns(KVStoreApp()))
+        engine = ReplayEngine(
+            store, executor, verify_mode="batched", window=window)
+        t0 = time.perf_counter()
+        state, stats = engine.run(genesis.copy(), to_height=to_height)
+        d = time.perf_counter() - t0
+        assert state.last_block_height == to_height
+        return d, stats
+
+    one_run()  # warmup: compile the shard-shape kernels
+    dt, stats = one_run()
+    return {
+        "to_height": to_height,
+        "window": window,
+        "seconds": round(dt, 2),
+        "sigs_verified": stats.sigs_verified,
+        "sigs_per_sec": round(stats.sigs_verified / dt, 1),
+        "forced_mesh_dispatch": True,
+    }
+
+
+def bench_two_backend():
+    """VERDICT Next #2: the two-backend replay comparison, recorded
+    even where it is unflattering. Both legs replay THE SAME stored
+    1000-validator chain prefix through the same ReplayEngine harness;
+    only the verify backend differs. Leg A lets dispatch pick honestly
+    on this host (= the native IFMA CPU engine). Leg B forces the
+    sharded mesh path in a child process — on a host without a real
+    accelerator that means XLA *emulating* the mesh on CPU, so the
+    record carries the flag. The chain is whatever prefix exists in
+    the store (generation at 1000 validators runs ~160 blocks/hour on
+    a 1-core box — signing, not verification, is the wall — so the
+    bench replays the available prefix rather than demanding the full
+    2000-block QUICK shape; a 24-block floor is generated on first
+    run). The stored r05 real-TPU 50k-block record rides along as the
+    cross-box yardstick."""
+    from cometbft_tpu.abci.client import AppConns
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.blocksync import ReplayEngine
+    from cometbft_tpu.state.execution import BlockExecutor, \
+        make_genesis_state
+    from cometbft_tpu.storage import BlockStore, open_kv
+    from cometbft_tpu.utils import factories as fx
+
+    os.makedirs("/tmp/ns_chain", exist_ok=True)
+    db_path = os.path.join("/tmp/ns_chain", "blockstore_2000b_1000v.db")
+    store = BlockStore(open_kv(db_path))
+    n_vals = 1000
+    signers = fx.make_signers(n_vals)
+    vals = fx.make_validator_set(signers)
+    genesis = make_genesis_state("ns-chain", vals)
+    if store.height() < 25:
+        if store.height():
+            raise SystemExit(f"store too short ({store.height()}); "
+                             f"delete {db_path}")
+        app = KVStoreApp()
+        pool = fx.RPool(n_vals, blocks_per_fill=32)
+        fx.make_chain(
+            25, n_validators=n_vals, chain_id="ns-chain", app=app,
+            block_store=store, verify_last_commit=False, r_pool=pool)
+    # the tip block's own commit only lands with the NEXT block's
+    # LastCommit, so a partially generated store replays to height-1
+    to_height = store.height() - 1
+    window = 4
+
+    def cpu_leg():
+        executor = BlockExecutor(AppConns(KVStoreApp()))
+        engine = ReplayEngine(
+            store, executor, verify_mode="batched", window=window)
+        t0 = time.perf_counter()
+        state, stats = engine.run(genesis.copy(), to_height=to_height)
+        dt = time.perf_counter() - t0
+        assert state.last_block_height == to_height
+        return dt, stats
+
+    cpu_leg()  # warmup: page the store, prime native tables
+    dt, stats = cpu_leg()
+    cpu_rec = {
+        "metric": "replay_two_backend_cpu_leg_1000v",
+        "backend": "native-cpu",
+        "to_height": to_height,
+        "window": window,
+        "seconds": round(dt, 2),
+        "sigs_verified": stats.sigs_verified,
+        "sigs_per_sec": round(stats.sigs_verified / dt, 1),
+        "blocks_per_sec": round(to_height / dt, 1),
+    }
+    real = _accel_devices()
+    emulated = real < 2
+    env = {"COMETBFT_TPU_MESH": "on"}
+    if emulated:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    mesh_rec = _spawn_child(["--two-backend-child"], env, timeout=3600)
+    mesh_rec["emulated_cpu_mesh"] = emulated
+    rec = {
+        "metric": "replay_two_backend_1000v",
+        "cpu_native": {
+            k: cpu_rec[k]
+            for k in ("to_height", "seconds", "sigs_per_sec",
+                      "blocks_per_sec")
+        },
+        "mesh_device": mesh_rec,
+        "ratio_cpu_over_mesh": round(
+            cpu_rec["sigs_per_sec"] / mesh_rec["sigs_per_sec"], 2),
+    }
+    # fold in the stored real-chip record for the cross-box ratio
+    path = os.path.join(os.path.dirname(__file__), "..", "WORKLOADS.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                old = json.loads(ln)
+                if old.get("metric") == "replay_50000b_1000v":
+                    rec["r05_tpu_50000b_sigs_per_sec"] = old["sigs_per_sec"]
+                    rec["ratio_r05_tpu_over_cpu"] = round(
+                        old["sigs_per_sec"] / cpu_rec["sigs_per_sec"], 2)
+    return [cpu_rec, rec]
+
+
 def main():
+    if "--multichip-child" in sys.argv:
+        i = sys.argv.index("--multichip-child")
+        _emit(multichip_child(int(sys.argv[i + 1]), int(sys.argv[i + 2])))
+        return
+    if "--two-backend-child" in sys.argv:
+        _emit(two_backend_child())
+        return
+    if "--multichip" in sys.argv:
+        rec = bench_multichip()
+        _emit(rec)
+        return
+    if "--two-backend" in sys.argv:
+        out = bench_two_backend()
+        for rec in out:
+            _emit(rec)
+        _merge_workloads(out)
+        return
     northstar = "--northstar" in sys.argv
     benches = (
         (bench_replay_northstar, bench_megacommit_mixed)
@@ -344,6 +660,10 @@ def main():
         rec = fn()
         print(json.dumps(rec))
         out.append(rec)
+    _merge_workloads(out)
+
+
+def _merge_workloads(out):
     path = os.path.join(os.path.dirname(__file__), "..", "WORKLOADS.json")
     existing = []
     if os.path.exists(path):
