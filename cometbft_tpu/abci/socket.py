@@ -242,6 +242,26 @@ class SocketClient:
     def check_tx(self, tx: bytes) -> T.CheckTxResult:
         return W.dec_check_tx_resp(self._call(W.CHECK_TX, tx))
 
+    def check_txs(self, txs: list[bytes]) -> list[T.CheckTxResult]:
+        """Pipelined batch CheckTx: enqueue every request before waiting
+        on any response, so one admission window costs one round-trip of
+        latency instead of len(txs) (the transport already preserves
+        order via the pending queue)."""
+        futs = []
+        for tx in txs:
+            fut = {"event": threading.Event()}
+            self._send_q.put((W.CHECK_TX, tx, fut))
+            futs.append(fut)
+        out = []
+        for fut in futs:
+            if not fut["event"].wait(self.timeout):
+                raise TimeoutError("ABCI batch check_tx timed out")
+            if "error" in fut:
+                raise ConnectionError(
+                    f"ABCI connection failed: {fut['error']}")
+            out.append(W.dec_check_tx_resp(fut["payload"]))
+        return out
+
     def prepare_proposal(self, txs: list[bytes], max_tx_bytes: int,
                          local_last_commit=None) -> list[bytes]:
         from ..encoding import proto as pb

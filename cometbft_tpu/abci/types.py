@@ -181,6 +181,13 @@ class Application(ABC):
     def check_tx(self, tx: bytes) -> CheckTxResult:
         return CheckTxResult()
 
+    def check_txs(self, txs: list[bytes]) -> list[CheckTxResult]:
+        """Batched CheckTx: one call per admission window instead of one
+        per tx, so a serialized client (LocalClient's shared mutex) pays
+        its lock once per window. Apps with per-tx logic get the loop
+        for free; apps that can vectorize override this."""
+        return [self.check_tx(tx) for tx in txs]
+
     # --- consensus connection ---
     def init_chain(self, req: InitChainRequest) -> InitChainResponse:
         return InitChainResponse()

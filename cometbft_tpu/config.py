@@ -134,10 +134,21 @@ class MempoolConfig:
     # cap tx gossip fan-out per broadcast; 0 floods every peer
     # (reference's experimental max-gossip-connections bound)
     experimental_max_gossip_connections: int = 0
+    # micro-batched admission pipeline: windows of up to
+    # `admission_window` txs drained after at most
+    # `admission_max_delay_ms` (latency bound), amortizing the app
+    # round-trip, batch signature verify, and lock acquisition.
+    # admission_window=0 disables the pipeline (per-tx admission).
+    admission_window: int = 256
+    admission_max_delay_ms: float = 2.0
+    # batch-verify ed25519 signatures of STX-enveloped txs at admission
+    admission_verify_sigs: bool = True
 
     def validate(self) -> None:
         if self.size <= 0 or self.cache_size <= 0:
             raise ValueError("mempool sizes must be positive")
+        if self.admission_window < 0 or self.admission_max_delay_ms < 0:
+            raise ValueError("admission window/delay must be >= 0")
 
 
 @dataclass

@@ -1,3 +1,12 @@
+from .admission import AdmissionPipeline, parse_signed_tx, wrap_signed_tx
 from .mempool import CListMempool, LRUTxCache, NopMempool, TxKey
 
-__all__ = ["CListMempool", "LRUTxCache", "NopMempool", "TxKey"]
+__all__ = [
+    "AdmissionPipeline",
+    "CListMempool",
+    "LRUTxCache",
+    "NopMempool",
+    "TxKey",
+    "parse_signed_tx",
+    "wrap_signed_tx",
+]

@@ -3,7 +3,15 @@
 Broadcasts newly admitted txs to peers on the mempool channel; received
 txs go through CheckTx with the sender recorded so they are not echoed
 back (the reference tracks per-peer send state; v1 relies on the LRU
-cache to stop loops)."""
+cache to stop loops).
+
+PR 8: gossip is batched end-to-end. The mempool's notifier hands the
+reactor whole admission windows (`on_new_txs`), which it coalesces into
+one multi-tx wire frame (repeated field 1 — old single-tx frames are
+the n=1 case, so mixed-version links keep working) and hands to the
+switch's backpressure-aware broadcast queue instead of fanning out
+per-tx from the admitting thread. Received frames feed the admission
+pipeline via the non-blocking submit path."""
 
 from __future__ import annotations
 
@@ -28,7 +36,13 @@ class MempoolReactor(Reactor):
         self.mempool = mempool
         self.switch = None
         self.max_gossip_peers = max_gossip_peers
-        mempool.on_new_tx.append(self._broadcast_tx)
+        # prefer the batched seam; plain mempool doubles (tests) may
+        # only expose the legacy per-tx list
+        batch_seam = getattr(mempool, "on_new_txs", None)
+        if batch_seam is not None:
+            batch_seam.append(self._broadcast_txs)
+        else:
+            mempool.on_new_tx.append(self._broadcast_tx)
 
     def channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5)]
@@ -37,11 +51,23 @@ class MempoolReactor(Reactor):
         self.switch = switch
 
     def _broadcast_tx(self, tx: bytes) -> None:
-        if self.switch is None:
+        self._broadcast_txs([tx])
+
+    def _broadcast_txs(self, txs: list[bytes]) -> None:
+        if self.switch is None or not txs:
             return
-        payload = pb.f_bytes(1, tx, emit_empty=True)
+        # one frame per window: repeated field 1
+        payload = b"".join(
+            pb.f_bytes(1, tx, emit_empty=True) for tx in txs
+        )
         if self.max_gossip_peers <= 0:
-            self.switch.broadcast(MEMPOOL_CHANNEL, payload)
+            # flood path: queue on the switch's async broadcast worker
+            # (backpressure-aware) when available
+            enqueue = getattr(self.switch, "queue_broadcast", None)
+            if enqueue is not None:
+                enqueue(MEMPOOL_CHANNEL, payload)
+            else:
+                self.switch.broadcast(MEMPOOL_CHANNEL, payload)
             return
         # sample a fresh subset per broadcast: a fixed prefix would
         # permanently starve the peers beyond the cap
@@ -55,9 +81,26 @@ class MempoolReactor(Reactor):
                 continue
 
     def receive(self, chan_id: int, peer, msg: bytes) -> None:
-        d = pb.fields_to_dict(msg)
-        tx = pb.as_bytes(d.get(1, b""))
-        try:
-            self.mempool.check_tx(tx, from_peer=peer.id)
-        except Exception:  # noqa: BLE001 — dup/full/invalid: drop
-            pass
+        # multi-tx frames carry repeated field 1; fields_to_dict is
+        # last-wins, so walk the raw field list
+        txs = [
+            pb.as_bytes(v)
+            for f, _wt, v in pb.parse_fields(msg)
+            if f == 1
+        ]
+        submit = getattr(self.mempool, "submit_tx", None)
+        for tx in txs:
+            try:
+                if submit is not None:
+                    # non-blocking: the admission pipeline delivers the
+                    # verdict to the future; peer gossip ignores it
+                    fut = submit(tx, from_peer=peer.id)
+                    fut.add_done_callback(_swallow)
+                else:
+                    self.mempool.check_tx(tx, from_peer=peer.id)
+            except Exception:  # noqa: BLE001 — dup/full/invalid: drop
+                pass
+
+
+def _swallow(fut) -> None:
+    fut.exception()  # consume so rejected gossip doesn't warn

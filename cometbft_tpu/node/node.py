@@ -176,7 +176,22 @@ class Node:
             max_tx_bytes=config.mempool.max_tx_bytes,
             cache_size=config.mempool.cache_size,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            recheck_window=config.mempool.admission_window or 256,
+            verify_sigs=config.mempool.admission_verify_sigs,
         )
+        if config.mempool.admission_window > 0:
+            # micro-batched admission: RPC handlers and peer receives
+            # enqueue; one drainer runs batch sig verify + one app
+            # CheckTx round + one locked insert per window
+            from ..mempool import AdmissionPipeline
+
+            self.mempool.attach_pipeline(AdmissionPipeline(
+                self.mempool,
+                window=config.mempool.admission_window,
+                max_delay_s=config.mempool.admission_max_delay_ms / 1e3,
+                verify_sigs=config.mempool.admission_verify_sigs,
+                backend=config.base.crypto_backend,
+            ))
         self.evidence_pool = EvidencePool(
             state_store=self.state_store, block_store=self.block_store,
             chain_id=self.genesis_doc.chain_id,
@@ -525,6 +540,7 @@ class Node:
 
     def stop(self) -> None:
         self.consensus.stop()
+        self.mempool.close()  # admission drainer + gossip notifier
         self.pruner.stop()
         if self.pex_reactor is not None:
             self.pex_reactor.stop()  # also persists the address book

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 from abc import ABC, abstractmethod
+from collections import deque
 
 from ..utils import trace as _trace
 from ..utils.log import logger
@@ -101,6 +102,13 @@ class Switch:
         # wire-message trace classifier (flight recorder); see
         # set_msg_tracer
         self.msg_tracer = None
+        # async broadcast queue (tx gossip): bounded, drop-oldest under
+        # saturation, drained by a worker thread so producers (the
+        # mempool notifier / admission drainer) never run peer I/O
+        self.broadcast_queue_limit = 4096
+        self._bcast_q: "deque[tuple[int, bytes]]" = deque()
+        self._bcast_cv = threading.Condition()
+        self._bcast_thread: threading.Thread | None = None
 
     def set_msg_tracer(self, fn) -> None:
         """Install a wire-message trace hook, called as
@@ -342,6 +350,44 @@ class Switch:
         for peer in self.peers():
             peer.send(chan_id, msg)
 
+    def queue_broadcast(self, chan_id: int, msg: bytes) -> None:
+        """Enqueue a broadcast for the async worker. Bounded: when the
+        queue is saturated (peers draining slower than frames arrive)
+        the OLDEST frame is shed — for tx gossip, newer txs are worth
+        more than stale ones, and the LRU cache re-delivers via other
+        routes. Depth and drops are exported."""
+        m = p2p_metrics()
+        with self._bcast_cv:
+            if self._stopped.is_set():
+                return
+            if self._bcast_thread is None:
+                self._bcast_thread = threading.Thread(
+                    target=self._broadcast_loop, daemon=True,
+                    name="p2p-broadcast",
+                )
+                self._bcast_thread.start()
+            if len(self._bcast_q) >= self.broadcast_queue_limit:
+                self._bcast_q.popleft()
+                m.broadcast_queue_dropped.inc()
+            self._bcast_q.append((chan_id, msg))
+            m.broadcast_queue_depth.set(len(self._bcast_q))
+            self._bcast_cv.notify()
+
+    def _broadcast_loop(self) -> None:
+        while True:
+            with self._bcast_cv:
+                while not self._bcast_q and not self._stopped.is_set():
+                    self._bcast_cv.wait(timeout=0.5)
+                if self._stopped.is_set():
+                    return
+                chan_id, msg = self._bcast_q.popleft()
+                p2p_metrics().broadcast_queue_depth.set(len(self._bcast_q))
+            for peer in self.peers():
+                try:
+                    peer.send(chan_id, msg)
+                except Exception:  # noqa: BLE001 — dead peer: skip
+                    continue
+
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         with self._lock:
             if self._peers.get(peer.id) is not peer:
@@ -355,6 +401,8 @@ class Switch:
 
     def stop(self) -> None:
         self._stopped.set()
+        with self._bcast_cv:
+            self._bcast_cv.notify_all()
         self.transport.close()
         for peer in self.peers():
             peer.stop()

@@ -509,10 +509,17 @@ def broadcast_tx_sync(env, params):
 
 def broadcast_tx_async(env, params):
     tx = bytes.fromhex(params["tx"])
-    try:
-        env.mempool.check_tx(tx)
-    except Exception:  # noqa: BLE001 — async: fire and forget
-        pass
+    submit = getattr(env.mempool, "submit_tx", None)
+    if submit is not None:
+        # truly async: enqueue into the admission pipeline and return
+        # without waiting for the window to drain
+        fut = submit(tx)
+        fut.add_done_callback(lambda f: f.exception())  # fire and forget
+    else:
+        try:
+            env.mempool.check_tx(tx)
+        except Exception:  # noqa: BLE001 — async: fire and forget
+            pass
     return {"code": 0, "hash": _hx(tmhash(tx))}
 
 

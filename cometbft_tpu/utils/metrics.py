@@ -237,6 +237,20 @@ class MempoolMetrics:
                                       "CheckTx rejections")
         self.recheck_times = reg.counter("mempool", "recheck_times",
                                          "Post-block rechecks")
+        self.tx_bytes = reg.gauge(
+            "mempool", "tx_bytes",
+            "Total bytes of pending txs (running counter, not a scan)")
+        # micro-batched admission pipeline (PR 8): windows amortize the
+        # app round-trip + signature verify + lock acquisition
+        self.admit_window_size = reg.histogram(
+            "mempool", "admit_window_size",
+            "Txs per admission window drained by the pipeline")
+        self.admit_queue_depth = reg.gauge(
+            "mempool", "admit_queue_depth",
+            "Txs waiting in the admission queue")
+        self.admit_latency = reg.histogram(
+            "mempool", "admit_latency",
+            "Seconds from enqueue to admission verdict")
 
 
 class P2PMetrics:
@@ -257,6 +271,14 @@ class P2PMetrics:
         self.peer_round = reg.gauge(
             "p2p", "peer_round", "Last known consensus round per peer",
             labels=("peer",))
+        # backpressure-aware broadcast queue (tx gossip off the
+        # admission path): depth is load, drops are shed backlog
+        self.broadcast_queue_depth = reg.gauge(
+            "p2p", "broadcast_queue_depth",
+            "Frames waiting in the async broadcast queue")
+        self.broadcast_queue_dropped = reg.counter(
+            "p2p", "broadcast_queue_dropped",
+            "Frames dropped from a saturated broadcast queue")
 
 
 class StateMetrics:

@@ -631,6 +631,113 @@ def bench_two_backend():
     return [cpu_rec, rec]
 
 
+def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
+    """Sustained tx-ingress workload (ROADMAP item #4): tools/txload.py
+    drives `clients` concurrent signed broadcast_tx_sync producers
+    against an in-process validator, once with per-tx admission (the
+    seed's path) and once with the micro-batched pipeline. The record
+    carries both runs; headline numbers are the batched mode's.
+
+    Machine gates (absolute txs/s + p99 commit latency, and the
+    batched-beats-pertx comparison) are asserted only on hosts with >=2
+    cores: on a 1-core box the producers, the admission drainer, and
+    consensus time-share one core, so a pass/fail would gate on
+    scheduler interleaving, not the ingest path — same pattern as the
+    multichip gate."""
+    import subprocess
+
+    dur = 3.0 if QUICK else duration_s
+
+    def one(mode):
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "txload.py")
+        p = subprocess.run(
+            [sys.executable, script, "--mode", mode, "--signed",
+             "--clients", str(clients), "--duration", str(dur),
+             "--window", str(window)],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"txload --mode {mode} rc={p.returncode}\n"
+                f"stderr: {p.stderr[-2000:]}")
+        for ln in reversed(p.stdout.strip().splitlines()):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+        raise RuntimeError(f"txload produced no JSON: {p.stdout[-500:]}")
+
+    # best-of-2 per mode: single samples on a time-shared host swing
+    # with scheduler interleaving (same reasoning as _best_of)
+    reps = 1 if QUICK else 2
+
+    def best(mode):
+        runs = [one(mode) for _ in range(reps)]
+        r = max(runs, key=lambda x: x["txs_per_sec"])
+        r["stat"] = f"best_of_{reps}"
+        print(f"  {mode}: {r['txs_per_sec']} txs/s  "
+              f"p99 {r['commit_latency_ms']['p99']} ms", file=sys.stderr)
+        return r
+
+    pertx = best("pertx")
+    batched = best("batched")
+
+    gate = {
+        "min_txs_per_sec": 1500.0,
+        "max_p99_commit_ms": 1500.0,
+        "batched_beats_pertx": True,
+    }
+    cores = os.cpu_count() or 1
+    starved = cores < 2
+    if starved:
+        gate["asserted"] = False
+        gate["reason"] = (
+            f"starved host: {cores} core(s) — producers, admission "
+            "drainer, and consensus time-share the core, so thresholds "
+            "would gate on scheduler interleaving; re-run "
+            "`python tools/workloads.py --ingest` on a >=2-core host"
+        )
+    else:
+        gate["asserted"] = True
+        assert batched["txs_per_sec"] >= gate["min_txs_per_sec"], (
+            f"sustained ingest {batched['txs_per_sec']} txs/s < "
+            f"{gate['min_txs_per_sec']}"
+        )
+        assert (batched["commit_latency_ms"]["p99"]
+                <= gate["max_p99_commit_ms"]), (
+            f"p99 commit latency {batched['commit_latency_ms']['p99']} ms "
+            f"> {gate['max_p99_commit_ms']} ms"
+        )
+        assert batched["txs_per_sec"] > pertx["txs_per_sec"], (
+            "micro-batched admission did not beat per-tx throughput"
+        )
+        assert (batched["commit_latency_ms"]["p99"]
+                < pertx["commit_latency_ms"]["p99"]), (
+            "micro-batched admission did not beat per-tx p99 latency"
+        )
+    return {
+        "metric": "ingest_sustained_load",
+        "clients": clients,
+        "duration_s": dur,
+        "signed": True,
+        "window": window,
+        "txs_per_sec": batched["txs_per_sec"],
+        "commit_latency_ms": batched["commit_latency_ms"],
+        "txs_per_app_call": batched["txs_per_app_call"],
+        "pertx_txs_per_sec": pertx["txs_per_sec"],
+        "pertx_commit_latency_ms": pertx["commit_latency_ms"],
+        "pertx_txs_per_app_call": pertx["txs_per_app_call"],
+        "speedup_txs_per_sec": round(
+            batched["txs_per_sec"] / max(pertx["txs_per_sec"], 1e-9), 2),
+        "p99_improvement": round(
+            pertx["commit_latency_ms"]["p99"]
+            / max(batched["commit_latency_ms"]["p99"], 1e-9), 2),
+        "gate": gate,
+    }
+
+
 def main():
     if "--multichip-child" in sys.argv:
         i = sys.argv.index("--multichip-child")
@@ -648,6 +755,11 @@ def main():
         for rec in out:
             _emit(rec)
         _merge_workloads(out)
+        return
+    if "--ingest" in sys.argv:
+        rec = bench_ingest_sustained_load()
+        _emit(rec)
+        _merge_workloads([rec])
         return
     northstar = "--northstar" in sys.argv
     benches = (
