@@ -234,6 +234,13 @@ class InstrumentationConfig:
     # Relative paths resolve under the node home. The COMETBFT_TPU_TRACE
     # env var overrides at process level (subprocess nodes, bench.py).
     trace_sink: str = ""
+    # tx lifecycle observatory (utils/txlife.py): sample 1 in N txs by
+    # hash prefix; 0 disables. The COMETBFT_TPU_TXLIFE env var wins
+    # over this (subprocess nodes, overhead harness).
+    txlife_sample_rate: int = 64
+    # /healthz on the metrics server: 200 while consensus height
+    # advanced within this many seconds, 503 after
+    healthz_window_s: float = 30.0
 
     def validate(self) -> None:
         if self.prometheus:
@@ -246,6 +253,12 @@ class InstrumentationConfig:
                 )
         if not self.namespace:
             raise ValueError("instrumentation.namespace must be non-empty")
+        if self.txlife_sample_rate < 0:
+            raise ValueError(
+                "instrumentation.txlife_sample_rate must be >= 0")
+        if self.healthz_window_s <= 0:
+            raise ValueError(
+                "instrumentation.healthz_window_s must be positive")
 
 
 @dataclass

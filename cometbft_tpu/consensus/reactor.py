@@ -305,7 +305,12 @@ _WIRE_MSG_KINDS = {
     8: "vote_set_bits", 9: "new_valid_block",
 }
 _VOTE_TYPE_NAMES = {1: "prevote", 2: "precommit", 32: "proposal"}
-_TRACE_CHANNELS = frozenset((STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL))
+# Mempool channel id duplicated here (mempool/reactor.py) to keep the
+# wire hook import-free of the mempool package: its tx frames become
+# msg="txs" records, adding tx-gossip edges to the clock alignment.
+_MEMPOOL_CHANNEL = 0x30
+_TRACE_CHANNELS = frozenset(
+    (STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL, _MEMPOOL_CHANNEL))
 
 
 def peek_wire_msg(raw: bytes) -> dict | None:
@@ -350,7 +355,13 @@ def trace_wire_msg(direction: str, peer_id: str, chan_id: int,
     if chan_id not in _TRACE_CHANNELS:
         return
     try:
-        meta = peek_wire_msg(raw)
+        if chan_id == _MEMPOOL_CHANNEL:
+            # tx gossip frame: repeated field 1, one element per tx
+            meta = {"msg": "txs",
+                    "n": sum(1 for f, _w, _v in pb.parse_fields(raw)
+                             if f == 1)}
+        else:
+            meta = peek_wire_msg(raw)
         if meta is None:
             return
         if direction == "send":

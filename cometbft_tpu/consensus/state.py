@@ -33,6 +33,7 @@ from dataclasses import dataclass, field as dc_field
 
 from ..state.execution import BlockExecutor, BlockValidationError, validate_block
 from ..utils import trace
+from ..utils import txlife as _txlife
 from ..utils.fail import fail_point
 from ..utils.log import logger
 from ..utils.metrics import consensus_metrics
@@ -161,6 +162,9 @@ class ConsensusState:
         self.commit_round = -1
         self.last_commit: VoteSet | None = None
         self.triggered_timeout_precommit = False
+        # tx lifecycle observatory: sampled (index, key) pairs of the
+        # current proposal block, hashed once per (height, block id)
+        self._txlife_cache: tuple | None = None
 
     # ==================================================================
     # lifecycle
@@ -459,6 +463,12 @@ class ConsensusState:
                     self.valid_round = v.round
                     self.valid_block = self.proposal_block
                     self.valid_block_id = maj
+            if (_txlife.enabled and not maj.is_zero()
+                    and self.proposal_block is not None
+                    and self.proposal_block_id == maj):
+                _txlife.stage_block(
+                    self._lifecycle_pairs(self.proposal_block, maj),
+                    "prevote_quorum", height=self.height, round=v.round)
 
         if self.round < v.round and prevotes.has_two_thirds_any():
             self.enter_new_round(self.height, v.round)
@@ -579,6 +589,9 @@ class ConsensusState:
                 block_time=self._proposal_block_time(),
             )
             bid = block_id_for(block)
+        if _txlife.enabled:
+            _txlife.stage_block(self._lifecycle_pairs(block, bid), "reap",
+                                height=h)
         proposal = Proposal(
             height=h, round=r, pol_round=self.valid_round, block_id=bid,
             timestamp=Timestamp.from_unix_ns(self.now_ns()),
@@ -588,8 +601,25 @@ class ConsensusState:
         if not self._replay_mode:
             self.broadcast(ProposalMessage(proposal))
             self.broadcast(bb)
+            if _txlife.enabled:
+                _txlife.stage_block(self._lifecycle_pairs(block, bid),
+                                    "gossip", height=h)
         self.send(ProposalMessage(proposal), "")
         self.send(bb, "")
+
+    def _lifecycle_pairs(self, block, bid):
+        """Sampled (index, key) pairs for a proposal block's txs —
+        hashed ONCE per (height, block id) so the reap/gossip/quorum
+        stamp sweeps don't re-hash the block per stage."""
+        if block is None or bid is None:
+            return ()
+        tag = (self.height, bid.hash)
+        cache = self._txlife_cache
+        if cache is not None and cache[0] == tag:
+            return cache[1]
+        pairs = _txlife.sampled_keys(block.data.txs)
+        self._txlife_cache = (tag, pairs)
+        return pairs
 
     def _proposal_block_time(self) -> Timestamp:
         if self.height == self.sm_state.initial_height:
@@ -715,6 +745,11 @@ class ConsensusState:
             # to nil + fresh parts for the committed BlockID)
             self.proposal_block = None
             self.proposal_block_id = None
+        if (_txlife.enabled and self.proposal_block is not None
+                and self.proposal_block_id == maj):
+            _txlife.stage_block(
+                self._lifecycle_pairs(self.proposal_block, maj),
+                "precommit_quorum", height=h, round=r)
         self._try_finalize_commit(h)
 
     def _try_finalize_commit(self, h: int) -> None:
@@ -725,6 +760,12 @@ class ConsensusState:
             return
         if self.proposal_block_id != maj or self.proposal_block is None:
             return  # waiting for the block to arrive
+        if _txlife.enabled:
+            # block may have arrived after enter_commit (late gossip):
+            # first-wins dedupes with the enter_commit stamp
+            _txlife.stage_block(
+                self._lifecycle_pairs(self.proposal_block, maj),
+                "precommit_quorum", height=h, round=self.commit_round)
         self._finalize_commit(h, maj)
 
     def _finalize_commit(self, h: int, maj: BlockID) -> None:

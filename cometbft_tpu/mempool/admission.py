@@ -49,6 +49,7 @@ from concurrent.futures import Future
 from ..crypto.ed25519 import Ed25519BatchVerifier, Ed25519PubKey
 from ..utils.metrics import mempool_metrics
 from ..utils import trace as _trace
+from ..utils import txlife as _txlife
 
 STX_MAGIC = b"STX\x01"
 SIGN_CONTEXT = b"cometbft-tpu/tx/v1"
@@ -67,6 +68,25 @@ def parse_signed_tx(tx: bytes):
         return None
     off = len(STX_MAGIC)
     return tx[off:off + 32], tx[off + 32:off + 96], tx[_STX_HEADER:]
+
+
+def _fail(fut: Future, exc: Exception) -> None:
+    """Fail a per-tx future, tolerating resolution races: stop() may
+    fail an in-flight window that a wedged drainer later resolves (or
+    the reverse), and a future must only be resolved once."""
+    if not fut.done():
+        try:
+            fut.set_exception(exc)
+        except Exception:  # noqa: BLE001 — lost the race, already done
+            pass
+
+
+def _ok(fut: Future) -> None:
+    if not fut.done():
+        try:
+            fut.set_result(None)
+        except Exception:  # noqa: BLE001 — lost the race, already done
+            pass
 
 
 class _Entry:
@@ -106,6 +126,11 @@ class AdmissionPipeline:
         self._cv = threading.Condition()
         self._thread: threading.Thread | None = None
         self._stopped = False
+        self._closed = False
+        # window the drainer popped but has not finished processing —
+        # stop() fails these too when the drainer won't exit in time
+        self._inflight: list[_Entry] = []
+        self.stop_timeout_s = 2.0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -124,16 +149,28 @@ class AdmissionPipeline:
             self._cv.notify_all()
         t = self._thread
         if t is not None:
-            t.join(timeout=2.0)
+            t.join(timeout=self.stop_timeout_s)
         self._thread = None
-        # fail whatever is still queued so blocked callers unblock
+        # Fail whatever is still queued so blocked callers unblock — and
+        # when the drainer did not exit within the timeout (wedged in a
+        # slow app CheckTx round, say), the in-flight window too:
+        # nobody else will ever resolve those futures. _fail/_ok
+        # tolerate the drainer limping in later.
         with self._cv:
             pending = list(self._q)
             self._q.clear()
+            pending.extend(self._inflight)
+        exc = RuntimeError("admission pipeline stopped")
         for e in pending:
-            if not e.future.done():
-                e.future.set_exception(
-                    RuntimeError("admission pipeline stopped"))
+            _fail(e.future, exc)
+
+    def close(self) -> None:
+        """Terminal stop for node shutdown: also refuses future submits
+        (no lazy drainer restart — late callers get an immediate error
+        instead of parking on a queue nobody drains)."""
+        with self._cv:
+            self._closed = True
+        self.stop()
 
     # -- producer side -----------------------------------------------------
     def submit(self, tx: bytes, from_peer: str = "") -> Future:
@@ -141,6 +178,10 @@ class AdmissionPipeline:
         admission or raises the per-tx rejection."""
         e = _Entry(tx, from_peer)
         with self._cv:
+            if self._closed:
+                e.future.set_exception(
+                    RuntimeError("admission pipeline closed"))
+                return e.future
             if self._stopped or self._thread is None:
                 # lazy start: the first submit after construction (or a
                 # node that never called start()) spins the drainer up
@@ -158,6 +199,8 @@ class AdmissionPipeline:
             self._q.append(e)
             mempool_metrics().admit_queue_depth.set(len(self._q))
             self._cv.notify()
+        if _txlife.enabled:
+            _txlife.track(tx, "enqueue")
         return e.future
 
     def check_tx(self, tx: bytes, from_peer: str = "") -> None:
@@ -187,14 +230,17 @@ class AdmissionPipeline:
                     self._cv.wait(timeout=left)
                 while self._q and len(batch) < self.window:
                     batch.append(self._q.popleft())
+                self._inflight = batch
                 mempool_metrics().admit_queue_depth.set(len(self._q))
             if batch:
                 try:
                     self._process_window(batch)
                 except Exception as exc:  # noqa: BLE001 — deliver, don't die
                     for e in batch:
-                        if not e.future.done():
-                            e.future.set_exception(exc)
+                        _fail(e.future, exc)
+                finally:
+                    with self._cv:
+                        self._inflight = []
 
     def _process_window(self, batch: list[_Entry]) -> None:
         m = mempool_metrics()
@@ -209,7 +255,7 @@ class AdmissionPipeline:
             try:
                 e.key = self.mempool.precheck(e.tx)
             except Exception as exc:  # noqa: BLE001 — per-tx verdict
-                e.future.set_exception(exc)
+                _fail(e.future, exc)
                 continue
             live.append(e)
         n_dup = len(batch) - len(live)
@@ -218,9 +264,15 @@ class AdmissionPipeline:
         # envelopes, through the crypto dispatch (native/rlc/ladder)
         n_sig_fail = 0
         t1 = time.perf_counter()
+        if _txlife.enabled:
+            for e in live:
+                _txlife.stage_key(e.key, "verify_start")
         if self.verify_sigs and live:
             live, n_sig_fail = self._verify_stage(live)
         t2 = time.perf_counter()
+        if _txlife.enabled:
+            for e in live:
+                _txlife.stage_key(e.key, "verify_end")
 
         # stage 2 — one batched app CheckTx round; no mempool lock held
         n_app_fail = 0
@@ -230,14 +282,17 @@ class AdmissionPipeline:
             for e, res in zip(live, results):
                 if res.code != 0:
                     self.mempool.note_rejected(e.key)
-                    e.future.set_exception(
-                        ValueError(f"tx rejected by app: code {res.code}"))
+                    _fail(e.future,
+                          ValueError(f"tx rejected by app: code {res.code}"))
                     n_app_fail += 1
                     continue
                 e.gas_wanted = res.gas_wanted
                 kept.append(e)
             live = kept
         t3 = time.perf_counter()
+        if _txlife.enabled:
+            for e in live:
+                _txlife.stage_key(e.key, "app_check")
 
         # stage 3 — single lock acquisition: insert survivors FIFO
         admitted: list[bytes] = []
@@ -246,10 +301,12 @@ class AdmissionPipeline:
                 [(e.key, e.tx, e.gas_wanted) for e in live])
             for e, err in zip(live, errs):
                 if err is not None:
-                    e.future.set_exception(err)
+                    _fail(e.future, err)
                 else:
                     admitted.append(e.tx)
-                    e.future.set_result(None)
+                    if _txlife.enabled:
+                        _txlife.stage_key(e.key, "insert")
+                    _ok(e.future)
         t4 = time.perf_counter()
 
         for e in batch:
@@ -298,8 +355,8 @@ class AdmissionPipeline:
         for i, e in enumerate(live):
             if i in bad:
                 self.mempool.note_rejected(e.key)  # counts failed_txs
-                e.future.set_exception(
-                    ValueError("tx rejected: invalid signature"))
+                _fail(e.future,
+                      ValueError("tx rejected: invalid signature"))
             else:
                 kept.append(e)
         return kept, len(bad)

@@ -31,6 +31,7 @@ import hashlib
 import threading
 
 from ..utils.metrics import mempool_metrics
+from ..utils import txlife as _txlife
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 
@@ -246,7 +247,13 @@ class CListMempool:
     def close(self) -> None:
         """Stop the admission pipeline and the notifier thread."""
         if self.pipeline is not None:
-            self.pipeline.stop()
+            # terminal close (refuses late submits) where available;
+            # plain stop() keeps duck-typed pipelines working
+            closer = getattr(self.pipeline, "close", None)
+            if closer is not None:
+                closer()
+            else:
+                self.pipeline.stop()
         with self._notify_cv:
             self._notify_stopped = True
             self._notify_cv.notify_all()
@@ -264,6 +271,7 @@ class CListMempool:
             self.pipeline.check_tx(tx, from_peer)
             return
         key = self.precheck(tx)
+        _txlife.stage_key(key, "verify_start")
         if self.verify_sigs:
             from .admission import SIGN_CONTEXT, parse_signed_tx
 
@@ -280,13 +288,16 @@ class CListMempool:
                 if not ok:
                     self.note_rejected(key)
                     raise ValueError("tx rejected: invalid signature")
+        _txlife.stage_key(key, "verify_end")
         resp = self.app_check_batch([tx])[0]  # no mempool lock held
         if resp.code != 0:
             self.note_rejected(key)
             raise ValueError(f"tx rejected by app: code {resp.code}")
+        _txlife.stage_key(key, "app_check")
         err = self.insert_batch([(key, tx, resp.gas_wanted)])[0]
         if err is not None:
             raise err
+        _txlife.stage_key(key, "insert")
         self.notify_new_txs([tx])
 
     def submit_tx(self, tx: bytes, from_peer: str = ""):

@@ -20,6 +20,7 @@ import random
 from ..encoding import proto as pb
 from ..p2p.conn import ChannelDescriptor
 from ..p2p.switch import Reactor
+from ..utils import txlife as _txlife
 
 MEMPOOL_CHANNEL = 0x30
 
@@ -90,6 +91,8 @@ class MempoolReactor(Reactor):
         ]
         submit = getattr(self.mempool, "submit_tx", None)
         for tx in txs:
+            if _txlife.enabled:
+                _txlife.track(tx, "arrival", src="gossip")
             try:
                 if submit is not None:
                     # non-blocking: the admission pipeline delivers the

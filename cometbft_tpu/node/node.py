@@ -66,6 +66,12 @@ class Node:
             if not os.path.isabs(sink):
                 sink = os.path.join(home, sink)
             _trace.configure(sink)
+        # tx lifecycle sampling: env var (already applied at import)
+        # wins over config, mirroring the trace-sink precedence
+        if os.environ.get("COMETBFT_TPU_TXLIFE") is None:
+            from ..utils import txlife as _txlife
+
+            _txlife.configure(config.instrumentation.txlife_sample_rate)
 
         def _p(rel: str) -> str:
             path = os.path.join(home, rel)
@@ -334,7 +340,8 @@ class Node:
             addr = config.instrumentation.prometheus_listen_addr
             mhost, _, mport = addr.rpartition(":")
             self.metrics_server = MetricsServer(
-                host=mhost or "127.0.0.1", port=int(mport or 0)
+                host=mhost or "127.0.0.1", port=int(mport or 0),
+                health_window_s=config.instrumentation.healthz_window_s,
             )
 
     # ------------------------------------------------------------------

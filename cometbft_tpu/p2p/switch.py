@@ -9,6 +9,7 @@ outbound ones (:396), Broadcast fans a message to every peer's channel
 from __future__ import annotations
 
 import threading
+import time
 from abc import ABC, abstractmethod
 from collections import deque
 
@@ -369,7 +370,7 @@ class Switch:
             if len(self._bcast_q) >= self.broadcast_queue_limit:
                 self._bcast_q.popleft()
                 m.broadcast_queue_dropped.inc()
-            self._bcast_q.append((chan_id, msg))
+            self._bcast_q.append((chan_id, msg, time.perf_counter()))
             m.broadcast_queue_depth.set(len(self._bcast_q))
             self._bcast_cv.notify()
 
@@ -380,8 +381,11 @@ class Switch:
                     self._bcast_cv.wait(timeout=0.5)
                 if self._stopped.is_set():
                     return
-                chan_id, msg = self._bcast_q.popleft()
-                p2p_metrics().broadcast_queue_depth.set(len(self._bcast_q))
+                chan_id, msg, t_enq = self._bcast_q.popleft()
+                m = p2p_metrics()
+                m.broadcast_queue_depth.set(len(self._bcast_q))
+                m.broadcast_queue_wait_seconds.observe(
+                    time.perf_counter() - t_enq)
             for peer in self.peers():
                 try:
                     peer.send(chan_id, msg)

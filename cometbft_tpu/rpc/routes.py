@@ -12,6 +12,7 @@ import base64
 from ..crypto import merkle
 from ..crypto.keys import tmhash
 from ..mempool.mempool import ErrMempoolFull, ErrTxInCache, ErrTxTooLarge
+from ..utils import txlife as _txlife
 
 
 class RPCError(Exception):
@@ -119,16 +120,18 @@ def health(env, params):
 def dump_trace(env, params):
     """Tail of the node's trace sink (observability debug aid).
 
-    Returns the last `n` JSONL records (default 100) written by
-    utils.trace; empty when tracing is disabled. Optional filters:
-    `name` keeps records whose span name contains the substring (e.g.
-    ``name=p2p.`` for the wire hooks), `kind` requires an exact kind
-    ("span" or "event"). With filters, the last `n` MATCHING records
-    out of the newest 1000 are returned.
+    Returns the last `n` JSONL records (default 100, hard cap 1000 so a
+    large sink can't balloon the RPC response) written by utils.trace;
+    empty when tracing is disabled. `limit` is accepted as an alias for
+    `n` (cosmos-style paging name). Optional filters: `name` keeps
+    records whose span name contains the substring (e.g. ``name=p2p.``
+    for the wire hooks), `kind` requires an exact kind ("span" or
+    "event"). With filters, the last `n` MATCHING records out of the
+    newest 1000 are returned.
     """
     from ..utils import trace
 
-    n = int(params.get("n", 100) or 100)
+    n = int(params.get("limit", params.get("n", 100)) or 100)
     n = max(1, min(n, 1000))
     name = str(params.get("name", "") or "")
     kind = str(params.get("kind", "") or "")
@@ -499,6 +502,8 @@ def consensus_params(env, params):
 
 def broadcast_tx_sync(env, params):
     tx = bytes.fromhex(params["tx"])
+    if _txlife.enabled:
+        _txlife.track(tx, "arrival", src="rpc")
     try:
         env.mempool.check_tx(tx)
         code, log = 0, ""
@@ -509,6 +514,8 @@ def broadcast_tx_sync(env, params):
 
 def broadcast_tx_async(env, params):
     tx = bytes.fromhex(params["tx"])
+    if _txlife.enabled:
+        _txlife.track(tx, "arrival", src="rpc")
     submit = getattr(env.mempool, "submit_tx", None)
     if submit is not None:
         # truly async: enqueue into the admission pipeline and return
@@ -527,6 +534,8 @@ def broadcast_tx_commit(env, params, timeout_s: float = 30.0):
     """Submit and wait for the tx to land in a block (reference
     rpc/core/mempool.go BroadcastTxCommit via event subscription)."""
     tx = bytes.fromhex(params["tx"])
+    if _txlife.enabled:
+        _txlife.track(tx, "arrival", src="rpc")
     sub = env.event_bus.subscribe(
         f"btc-{tmhash(tx).hex()[:8]}", f"tm.event = 'Tx' AND tx.hash = '{_hx(tmhash(tx))}'"
     )

@@ -313,9 +313,14 @@ class BlockExecutor:
 
         from ..abci.types import FinalizeBlockRequest
         from ..utils import trace
+        from ..utils import txlife as _txlife
         from ..utils.fail import fail_point
         from ..utils.metrics import state_metrics
 
+        # sampled txs of this block, hashed once: the apply/commit/notify
+        # lifecycle stamps all sweep the same pairs
+        life = _txlife.sampled_keys(block.data.txs) if _txlife.enabled else ()
+        h_ = block.header.height
         t0 = _time.perf_counter()
         validate_block(
             state,
@@ -352,6 +357,8 @@ class BlockExecutor:
             raise BlockValidationError("app returned wrong number of tx results")
 
         t_finalize = _time.perf_counter()
+        if life:
+            _txlife.stage_block(life, "apply", height=h_)
         fail_point()  # reference execution.go:258 (post-FinalizeBlock, pre-save)
         new_state = self._update_state(state, block_id, block, resp)
 
@@ -377,6 +384,8 @@ class BlockExecutor:
             self.evidence_pool.update(new_state, block.evidence)
 
         t_commit = _time.perf_counter()
+        if life:
+            _txlife.stage_block(life, "commit", height=h_)
         fail_point()  # reference execution.go:301 (post-Commit, pre-save)
         if self.state_store is not None:
             self.state_store.save(new_state)
@@ -399,6 +408,10 @@ class BlockExecutor:
                 self.event_bus.publish_validator_set_updates(
                     resp.validator_updates
                 )
+        if life:
+            # notify closes the lifecycle whether or not an event bus is
+            # wired (without one there is simply nothing to wait on)
+            _txlife.stage_block(life, "notify", height=h_)
         for handler in self.event_handlers:
             handler(block, resp)
         t_end = _time.perf_counter()

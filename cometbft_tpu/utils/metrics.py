@@ -12,7 +12,9 @@ metric state cannot leak across tests.
 
 from __future__ import annotations
 
+import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 NAMESPACE = "cometbft"
@@ -118,16 +120,67 @@ class Histogram(_Metric):
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
+        # per-(labelset, bucket index) exemplar: (id, value, epoch ts) —
+        # latest observation wins, like the prometheus client libraries
+        self._exemplars: dict[tuple, dict[int, tuple]] = {}
 
-    def observe(self, value: float, *labels) -> None:
+    def observe(self, value: float, *labels, exemplar: str | None = None
+                ) -> None:
         key = self._key(tuple(labels))
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            bucket_idx = len(self.buckets)  # +Inf
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    bucket_idx = min(bucket_idx, i)
             counts[-1] += 1  # +Inf
             self._sums[key] = self._sums.get(key, 0.0) + value
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[bucket_idx] = (
+                    str(exemplar), value, time.time())
+
+    def exemplars(self) -> dict[tuple, dict[int, tuple]]:
+        """{labels: {bucket index: (id, value, ts)}} — bucket index
+        len(buckets) is +Inf. For the OpenMetrics exposition and the
+        latency-observatory tooling (a p99 bucket's exemplar names a
+        concrete tx hash to look up in the trace sink)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._exemplars.items()}
+
+    def expose_openmetrics(self) -> list[str]:
+        """Bucket lines with `# {trace_id}` exemplar suffixes
+        (OpenMetrics syntax). Only served when the scraper opts in
+        (GET /metrics?exemplars=1): exemplar suffixes are not valid in
+        the classic text format that default scrapes negotiate."""
+        out = []
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+            exem = {k: dict(v) for k, v in self._exemplars.items()}
+        for key, counts in items:
+            base = self._fmt_labels(key)[1:-1] if self.labels else ""
+            ex = exem.get(key, {})
+            for i, b in enumerate(self.buckets):
+                le = f'le="{b}"'
+                lbl = "{" + (base + "," if base else "") + le + "}"
+                line = f"{self.name}_bucket{lbl} {counts[i]}"
+                e = ex.get(i)
+                if e is not None:
+                    line += (f' # {{trace_id="{_escape_label(e[0])}"}}'
+                             f" {e[1]} {e[2]}")
+                out.append(line)
+            lbl = "{" + (base + "," if base else "") + 'le="+Inf"' + "}"
+            line = f"{self.name}_bucket{lbl} {counts[-1]}"
+            e = ex.get(len(self.buckets))
+            if e is not None:
+                line += (f' # {{trace_id="{_escape_label(e[0])}"}}'
+                         f" {e[1]} {e[2]}")
+            out.append(line)
+            sfx = "{" + base + "}" if base else ""
+            out.append(f"{self.name}_sum{sfx} {sums[key]}")
+            out.append(f"{self.name}_count{sfx} {counts[-1]}")
+        return out
 
     def snapshot(self) -> dict[tuple, dict]:
         """{labels: {"count": n, "sum": s}} for programmatic readers."""
@@ -190,7 +243,11 @@ class Registry:
             self._metrics.clear()
             self._names.clear()
 
-    def expose_text(self) -> str:
+    def expose_text(self, openmetrics: bool = False) -> str:
+        """Text exposition; `openmetrics=True` adds exemplar suffixes to
+        histogram bucket lines (served only on explicit opt-in —
+        GET /metrics?exemplars=1 — since the classic format has no
+        exemplar syntax)."""
         lines = []
         with self._lock:
             metrics = list(self._metrics)
@@ -198,7 +255,10 @@ class Registry:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.TYPE}")
-            lines.extend(m.expose())
+            if openmetrics and isinstance(m, Histogram):
+                lines.extend(m.expose_openmetrics())
+            else:
+                lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
 
@@ -206,6 +266,14 @@ DEFAULT_REGISTRY = Registry()
 
 
 # -- subsystem bundles (reference */metrics.go) -----------------------------
+
+# Sub-second buckets for the tx-lifecycle waterfall: single-node stage
+# latencies live in the 0.5ms–2.5s band (admission windows are ~ms,
+# consensus rounds ~100ms–1s); the default buckets start too coarse.
+TX_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5)
+
+
 class ConsensusMetrics:
     def __init__(self, reg: Registry | None = None):
         reg = reg or DEFAULT_REGISTRY
@@ -227,6 +295,18 @@ class ConsensusMetrics:
         self.step_duration_seconds = reg.histogram(
             "consensus", "step_duration_seconds",
             "Time spent in each consensus step", labels=("step",))
+        # tx lifecycle observatory (utils/txlife.py): consensus-side
+        # waterfall stages + the end-to-end arrival->commit latency,
+        # bucket exemplars carrying sampled tx hashes
+        self.tx_stage_seconds = reg.histogram(
+            "consensus", "tx_stage_seconds",
+            "Per-tx lifecycle stage latency, consensus-side stages "
+            "(proposal_wait/consensus/apply/notify); sampled txs only",
+            labels=("stage",), buckets=TX_STAGE_BUCKETS)
+        self.tx_commit_seconds = reg.histogram(
+            "consensus", "tx_commit_seconds",
+            "Per-tx end-to-end arrival->commit latency; sampled txs only",
+            buckets=TX_STAGE_BUCKETS)
 
 
 class MempoolMetrics:
@@ -251,6 +331,13 @@ class MempoolMetrics:
         self.admit_latency = reg.histogram(
             "mempool", "admit_latency",
             "Seconds from enqueue to admission verdict")
+        # tx lifecycle observatory (utils/txlife.py): mempool-side
+        # waterfall stages, bucket exemplars carrying sampled tx hashes
+        self.tx_stage_seconds = reg.histogram(
+            "mempool", "tx_stage_seconds",
+            "Per-tx lifecycle stage latency, mempool-side stages "
+            "(admit_wait/verify/app_check); sampled txs only",
+            labels=("stage",), buckets=TX_STAGE_BUCKETS)
 
 
 class P2PMetrics:
@@ -279,6 +366,10 @@ class P2PMetrics:
         self.broadcast_queue_dropped = reg.counter(
             "p2p", "broadcast_queue_dropped",
             "Frames dropped from a saturated broadcast queue")
+        self.broadcast_queue_wait_seconds = reg.histogram(
+            "p2p", "broadcast_queue_wait_seconds",
+            "Enqueue->send wait of frames in the async broadcast queue",
+            buckets=TX_STAGE_BUCKETS)
 
 
 class StateMetrics:
@@ -428,17 +519,57 @@ def reset_bundles() -> None:
         DEFAULT_REGISTRY.clear()
 
 
+def _default_height_fn() -> float:
+    """Consensus height as the liveness signal for /healthz: the bundle
+    gauge is set by `_finalize_commit` on every decided block."""
+    return consensus_metrics().height.values().get((), 0.0)
+
+
 class MetricsServer:
     """Serves the registry at /metrics (reference prometheus listener).
 
-    Only `GET /metrics` is answered; other paths get 404, other methods
-    405 — matching what a prometheus scraper expects from a metrics
-    endpoint.
+    Routes:
+
+    * ``GET /metrics`` — classic text exposition. Append
+      ``?exemplars=1`` for OpenMetrics-style exemplar suffixes on
+      histogram buckets (opt-in: classic scrapes must stay parseable).
+    * ``GET /healthz`` — liveness for e2e drivers and soak loops: 200
+      while consensus height has advanced within `health_window_s`
+      seconds, 503 once it stalls longer than that. The server start is
+      treated as an advance (grace window for boot/genesis). JSON body
+      with height / seconds-since-advance either way.
+
+    Other paths get 404, other methods 405 — matching what a prometheus
+    scraper expects from a metrics endpoint.
     """
 
     def __init__(self, registry: Registry | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 health_window_s: float = 30.0, height_fn=None):
         reg = registry or DEFAULT_REGISTRY
+        height_fn = height_fn or _default_height_fn
+        # health state shared with handler threads: last observed height
+        # and the monotonic instant it last changed
+        health = {"height": None, "advanced": time.monotonic()}
+        health_lock = threading.Lock()
+        window_s = float(health_window_s)
+
+        def health_probe() -> tuple[bool, dict]:
+            try:
+                h = float(height_fn())
+            except Exception:  # noqa: BLE001 — probe must not 500
+                h = 0.0
+            now = time.monotonic()
+            with health_lock:
+                if health["height"] is None or h != health["height"]:
+                    health["height"] = h
+                    health["advanced"] = now
+                idle = now - health["advanced"]
+            ok = idle <= window_s
+            return ok, {"status": "ok" if ok else "stalled",
+                        "height": h,
+                        "since_advance_s": round(idle, 3),
+                        "window_s": window_s}
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -453,10 +584,21 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path.split("?", 1)[0] != "/metrics":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    ok, info = health_probe()
+                    body = (json.dumps(info) + "\n").encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path != "/metrics":
                     self._refuse(404, "not found; metrics at /metrics\n")
                     return
-                body = reg.expose_text().encode()
+                om = "exemplars=1" in query.split("&")
+                body = reg.expose_text(openmetrics=om).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")

@@ -144,6 +144,7 @@ def _match_key(r: dict):
     return (
         r.get("msg"), r.get("height"), r.get("round"),
         r.get("type"), r.get("idx"), r.get("step"), r.get("chan"),
+        r.get("n"),
     )
 
 
@@ -247,6 +248,17 @@ class MergedTrace:
                 if isinstance(h, int):
                     hs.add(h)
         return sorted(hs)
+
+    def tx_lifecycles(self) -> dict[str, list[dict]]:
+        """tx hex -> that tx's ``tx.lifecycle`` records across every
+        node, in aligned time order (tools/latency_analyze.py input).
+        Records carry the merge additions ``_node``/``_t`` plus the
+        emitter's ``stage`` and within-process ``mono`` clock."""
+        out: dict[str, list[dict]] = defaultdict(list)
+        for r in self.records:
+            if r.get("name") == "tx.lifecycle" and r.get("tx"):
+                out[str(r["tx"])].append(r)
+        return dict(out)
 
     def timeline(self, height: int | None = None,
                  names: set[str] | None = None) -> list[dict]:

@@ -295,3 +295,38 @@ def test_multi_tx_gossip_frame_roundtrip():
     assert sorted(receiver.reap_max_txs(-1)) == [b"x=1", b"y=2", b"z=3"]
     sender.close()
     receiver.close()
+
+
+def test_stop_fails_pending_futures_promptly():
+    """Node stop while the drainer holds queued txs: every pending
+    per-tx future must fail promptly (no caller parked forever on a
+    queue nobody drains), and submits after close() are refused."""
+    release = threading.Event()
+
+    class BlockingApp(KVStoreApp):
+        def check_tx(self, tx):
+            release.wait(10)
+            return super().check_tx(tx)
+
+        def check_txs(self, txs):
+            release.wait(10)
+            return [KVStoreApp.check_tx(self, tx) for tx in txs]
+
+    mp = _mp(window=4, max_delay_s=0.001, app=BlockingApp())
+    mp.pipeline.stop_timeout_s = 0.2
+    # first window wedges in the blocked app call (in-flight); the rest
+    # stay queued behind it
+    futures = [mp.pipeline.submit(f"k{i}={i}".encode()) for i in range(12)]
+    time.sleep(0.1)  # let the drainer pop a window and block in the app
+    t0 = time.monotonic()
+    mp.close()
+    took = time.monotonic() - t0
+    assert took < 2.0, f"close() hung {took:.2f}s on a wedged drainer"
+    for fut in futures:
+        with pytest.raises(RuntimeError, match="admission pipeline"):
+            fut.result(timeout=1)
+    # closed is terminal: late submits get an immediate error, not a
+    # future parked on a dead queue
+    with pytest.raises(RuntimeError, match="closed"):
+        mp.pipeline.submit(b"late=1").result(timeout=1)
+    release.set()

@@ -6,6 +6,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -471,3 +472,69 @@ def test_fail_point_crash_and_wal_recovery(tmp_path):
     )
     assert p2.returncode == 0, p2.stderr[-2000:]
     assert "recovered-to-6" in p2.stdout
+
+
+def test_healthz_liveness_follows_height_advance():
+    """/healthz: 200 while consensus height advances within the window
+    (server start counts as an advance — boot grace), 503 once the
+    height freezes past it, 200 again when it moves."""
+    height = {"v": 0.0}
+    srv = MetricsServer(registry=Registry(), health_window_s=0.3,
+                        height_fn=lambda: height["v"])
+    srv.start()
+    try:
+        host, port = srv.addr
+        url = f"http://{host}:{port}/healthz"
+        body = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert body["status"] == "ok"
+        time.sleep(0.45)  # no advance past the window -> stalled
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "stalled"
+        height["v"] = 7.0  # consensus moved: liveness restored
+        body = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert body["status"] == "ok"
+        assert body["height"] == 7.0
+    finally:
+        srv.stop()
+
+
+def test_exemplar_exposition_is_opt_in():
+    """Histogram exemplars surface only on /metrics?exemplars=1 in
+    OpenMetrics `# {trace_id=...}` syntax; the default classic-format
+    scrape stays byte-compatible (strict parsers reject suffixes)."""
+    reg = Registry()
+    h = reg.histogram("mempool", "tx_stage_seconds_t", "stage spans",
+                      labels=("stage",), buckets=(0.1, 1.0))
+    h.observe(0.05, "verify", exemplar="00aa11bb22cc33dd")
+    srv = MetricsServer(registry=reg)
+    srv.start()
+    try:
+        host, port = srv.addr
+        plain = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        assert "# {" not in plain
+        parse_exposition(plain)  # strict classic parser stays happy
+        om = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics?exemplars=1", timeout=5
+        ).read().decode()
+        assert 'trace_id="00aa11bb22cc33dd"' in om
+        assert 'le="0.1"' in om
+    finally:
+        srv.stop()
+
+
+def test_bench_compare_advisory_never_gates():
+    """tools/bench_compare.py --advisory: tier-1's regression guardrail
+    is informational — rc 0 regardless of what the diff says, and a
+    tight threshold still renders the table instead of failing."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_compare.py"),
+         "--advisory", "--threshold", "0.001"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    assert "bench_compare:" in p.stdout

@@ -338,3 +338,28 @@ def test_rpc_tx_prove_and_pagination(rpc_node):
     bs = c.block_search(query="block.height >= 1", per_page=1, page=1,
                         order_by="desc")
     assert len(bs["blocks"]) == 1 and int(bs["total_count"]) >= 1
+
+
+def test_dump_trace_limit_param_and_cap(tmp_path):
+    """dump_trace `limit` (alias of the older `n`): defaults to the
+    last 100 records, serves the newest ones, and clamps to the
+    documented [1, 1000] bounds instead of erroring."""
+    from cometbft_tpu.rpc.routes import dump_trace
+    from cometbft_tpu.utils import trace
+
+    trace.configure(os.path.join(str(tmp_path), "trace.jsonl"))
+    try:
+        for h in range(150):
+            trace.event("p2p.recv", msg="vote", height=h)
+        assert len(dump_trace(None, {})["records"]) == 100
+        res = dump_trace(None, {"limit": "5"})
+        assert len(res["records"]) == 5
+        assert res["records"][-1]["height"] == 149  # newest tail
+        assert len(dump_trace(None, {"n": "7"})["records"]) == 7
+        # explicit limit wins over the legacy alias
+        assert len(dump_trace(None, {"limit": "3", "n": "9"})["records"]) == 3
+        # clamped, not an error
+        assert len(dump_trace(None, {"limit": "100000"})["records"]) == 150
+        assert len(dump_trace(None, {"limit": "0"})["records"]) == 1
+    finally:
+        trace.disable()

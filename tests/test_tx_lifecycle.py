@@ -1,0 +1,193 @@
+"""Tx lifecycle observatory tests (ISSUE 9 tentpole).
+
+Covers: deterministic hash-prefix sampling (same decision for the same
+tx at any call site, partition matches the pointwise predicate),
+first-stamp-wins dedupe, histogram + exemplar plumbing, complete
+monotonic stage sequences for sampled txs under concurrent admission
+(and SILENCE for unsampled ones), and the latency_analyze stage
+waterfall on a synthetic multi-tx sink."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.mempool import AdmissionPipeline, CListMempool
+from cometbft_tpu.utils import trace, txlife
+from cometbft_tpu.utils.metrics import (
+    consensus_metrics,
+    mempool_metrics,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+def _mp(window=16, max_delay_s=0.002, **kw):
+    mp = CListMempool(AppConns(KVStoreApp()), **kw)
+    mp.attach_pipeline(AdmissionPipeline(
+        mp, window=window, max_delay_s=max_delay_s, backend="cpu"))
+    return mp
+
+
+def test_sampling_deterministic_per_hash():
+    txs = [f"k{i}={i}".encode() for i in range(2000)]
+    keys = [txlife.key_of(tx) for tx in txs]
+    try:
+        txlife.configure(4)
+        first = [txlife.sampled(k) for k in keys]
+        # decision is a pure function of the hash: stable across calls
+        assert [txlife.sampled(k) for k in keys] == first
+        # block sweep produces exactly the pointwise-sampled subset
+        assert txlife.sampled_keys(txs) == [
+            (i, k) for i, (k, s) in enumerate(zip(keys, first)) if s]
+        # 1/4 prefix sampling over 2000 hashes: a real partition
+        n = sum(first)
+        assert 0 < n < len(txs)
+        assert abs(n / len(txs) - 0.25) < 0.1
+        txlife.configure(1)
+        assert all(txlife.sampled(k) for k in keys)
+        txlife.configure(0)
+        assert not txlife.enabled
+        assert not any(txlife.sampled(k) for k in keys)
+        assert txlife.sampled_keys(txs) == []
+    finally:
+        txlife.reset()
+
+
+def test_stage_stamps_first_wins_and_feed_histograms():
+    try:
+        txlife.configure(1)
+        tx = b"life=1"
+        key = txlife.key_of(tx)
+
+        def counts():
+            mem = {k: v["count"] for k, v in
+                   mempool_metrics().tx_stage_seconds.snapshot().items()}
+            con = {k: v["count"] for k, v in
+                   consensus_metrics().tx_stage_seconds.snapshot().items()}
+            e2e = consensus_metrics().tx_commit_seconds.snapshot().get(
+                (), {}).get("count", 0)
+            return mem, con, e2e
+
+        mem0, con0, e2e0 = counts()
+        for st in txlife.BOUNDARIES[:-1]:
+            txlife.stage_key(key, st)
+        # re-stamping is a no-op (re-gossiped duplicates don't restamp)
+        txlife.stage_key(key, "arrival")
+        txlife.stage_key(key, "commit")
+        txlife.stage_key(key, "notify")
+        mem1, con1, e2e1 = counts()
+        for label, _s, _e in txlife.WATERFALL:
+            b0 = mem0 if label in ("admit_wait", "verify",
+                                   "app_check") else con0
+            b1 = mem1 if label in ("admit_wait", "verify",
+                                   "app_check") else con1
+            assert b1.get((label,), 0) == b0.get((label,), 0) + 1, label
+        assert e2e1 == e2e0 + 1
+        # exemplar carries the sampled tx hash prefix
+        ex = consensus_metrics().tx_commit_seconds.exemplars()
+        assert any(e[0] == key.hex()[:16]
+                   for per_bucket in ex.values()
+                   for e in per_bucket.values())
+        # notify closed the lifecycle: live state dropped
+        assert key not in txlife._live
+    finally:
+        txlife.reset()
+
+
+def test_concurrent_admission_stamps_sampled_only(tmp_path):
+    """Concurrent producers through the micro-batched pipeline: every
+    SAMPLED tx gets the full monotonic admission stage sequence in its
+    tx.lifecycle records; unsampled txs emit nothing."""
+    sink = os.path.join(str(tmp_path), "trace.jsonl")
+    try:
+        txlife.configure(2)
+        trace.configure(sink)
+        # pre-partition the workload with the same predicate the
+        # tracker uses — determinism means we know what to expect
+        txs = [f"c{i}={i}".encode() for i in range(200)]
+        expect = {
+            txlife.key_of(tx).hex()[:16]: txlife.sampled(txlife.key_of(tx))
+            for tx in txs
+        }
+        assert 0 < sum(expect.values()) < len(txs)
+        mp = _mp(window=32)
+        errs: list = []
+
+        def producer(chunk):
+            for tx in chunk:
+                try:
+                    mp.check_tx(tx)
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+        threads = [
+            threading.Thread(target=producer, args=(txs[i::8],))
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        mp.close()
+        trace.flush()
+        assert not errs
+        by_tx: dict[str, list] = {}
+        with open(sink) as f:
+            for ln in f:
+                r = json.loads(ln)
+                if r.get("name") == "tx.lifecycle":
+                    by_tx.setdefault(r["tx"], []).append(r)
+        sampled_hex = {h for h, s in expect.items() if s}
+        assert set(by_tx) == sampled_hex  # unsampled emitted NOTHING
+        admission_chain = (
+            "enqueue", "verify_start", "verify_end", "app_check", "insert")
+        for h, recs in by_tx.items():
+            stages = {r["stage"]: r["mono"] for r in recs}
+            assert set(stages) == set(admission_chain), (h, stages)
+            monos = [stages[s] for s in admission_chain]
+            assert monos == sorted(monos), (h, stages)  # monotonic
+    finally:
+        trace.disable()
+        txlife.reset()
+
+
+def test_latency_analyze_synthetic_waterfall(tmp_path):
+    """latency_analyze on a hand-built sink: names the dominant stage,
+    reconciles stage medians to measured e2e, skips partial chains."""
+    import latency_analyze
+
+    sink = os.path.join(str(tmp_path), "trace.jsonl")
+    with open(sink, "w") as f:
+        f.write(json.dumps({"ts": 100.0, "pid": 1, "name": "node.start",
+                            "kind": "event", "node": "n0"}) + "\n")
+        for i in range(20):
+            t0, mono = 100.0 + i * 0.5, 10.0 + i * 0.5
+            dt = 0.0
+            for st in txlife.BOUNDARIES:
+                dt += 0.05 if st == "precommit_quorum" else 0.002
+                f.write(json.dumps({
+                    "ts": t0 + dt, "pid": 1, "name": "tx.lifecycle",
+                    "kind": "event", "tx": f"{i:016x}", "stage": st,
+                    "mono": round(mono + dt, 6)}) + "\n")
+        # a partial chain (in flight at shutdown) must not pollute stats
+        f.write(json.dumps({"ts": 200.0, "pid": 1, "name": "tx.lifecycle",
+                            "kind": "event", "tx": "deadbeef00000000",
+                            "stage": "arrival", "mono": 110.0}) + "\n")
+    rep = latency_analyze.analyze([sink])
+    assert rep["txs_sampled"] == 21
+    assert rep["txs_complete"] == 20
+    assert rep["dominant_stage_p99"] == "consensus"
+    assert rep["stages"]["consensus"]["p99_exemplar_tx"] in rep["e2e_ms"][
+        "p99_exemplar_tx"] or rep["stages"]["consensus"]["n"] == 20
+    rec = rep["reconciliation"]
+    assert rec["within_tolerance"], rec
+    assert abs(rec["sum_stage_p50_ms"] - rec["e2e_p50_ms"]) < 0.5
+    # the rendered table names the dominant stage for humans too
+    text = latency_analyze.render(rep)
+    assert "consensus" in text and "dominant" in text

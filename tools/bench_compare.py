@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Compare fresh bench/workload JSON against the last committed round.
+
+Loads the working-tree copies of the benchmark artifacts (default:
+WORKLOADS.json and BENCH_r05.json) and their committed baselines via
+``git show <ref>:<file>``, flattens every numeric leaf to a dotted key,
+and reports relative changes that move in the WRONG direction past a
+threshold. Direction is inferred from the key name:
+
+  higher-better: *per_sec, *per_sec*, throughput, speedup, improvement,
+                 txs_per_app_call, blocks_per_s, sigs_per_sec, ...
+  lower-better:  *ms, *latency*, p50/p99, seconds, elapsed, overhead,
+                 degradation, *wait*, relative_error, sink_bytes
+  neutral:       everything else (counts, heights, config echoes) —
+                 reported in the diff but never a regression
+
+This is an ADVISORY guardrail, not a CI gate: bench numbers on a
+shared/1-core host swing with scheduler interleaving, so tier-1 invokes
+it with --advisory (always exit 0) and humans read the table. Without
+--advisory it exits 1 on regressions, for use on quiet dedicated boxes.
+
+    python tools/bench_compare.py [--files F...] [--ref HEAD]
+        [--threshold 0.10] [--advisory] [--json]
+
+Missing baselines (file not in the ref, not a git checkout, git absent)
+are skipped gracefully — a fresh artifact is not a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_FILES = ("WORKLOADS.json", "BENCH_r05.json")
+
+_HIGHER = ("per_sec", "per_s", "throughput", "speedup", "improvement",
+           "per_app_call", "per_core", "headers_per", "txs_per",
+           "sigs_per", "blocks_per")
+_LOWER = ("_ms", "ms.", "latency", "p50", "p99", "seconds", "elapsed",
+          "overhead", "degradation", "wait", "relative_error",
+          "sink_bytes", "duration")
+
+
+def direction(key: str) -> str:
+    k = key.lower()
+    # lower-better wins ties like "commit_latency_ms.p99" vs a stray
+    # "per" substring; latency keys are the ones regressions hide in
+    if any(t in k for t in _LOWER):
+        return "lower"
+    if any(t in k for t in _HIGHER):
+        return "higher"
+    return "neutral"
+
+
+def _load(text: str):
+    """Whole-file JSON, else JSONL keyed by each record's `metric`."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        out = {}
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out[str(rec.get("metric", len(out)))] = rec
+        return out
+
+
+def _flatten(obj, prefix: str = "", out: dict | None = None) -> dict:
+    if out is None:
+        out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f"{prefix}{k}.", out)
+    elif isinstance(obj, bool):
+        pass  # bools are flags, not measurements
+    elif isinstance(obj, (int, float)):
+        out[prefix.rstrip(".")] = float(obj)
+    return out
+
+
+def _git_show(ref: str, relpath: str) -> str | None:
+    try:
+        p = subprocess.run(
+            ["git", "show", f"{ref}:{relpath}"],
+            capture_output=True, text=True, timeout=30, cwd=REPO,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return p.stdout if p.returncode == 0 else None
+
+
+def diff_flat(base: dict, cur: dict, threshold: float) -> dict:
+    """Directional diff of two flattened numeric-leaf dicts."""
+    regressions, improvements, changed = [], [], 0
+    for key in sorted(set(cur) & set(base)):
+        b, c = base[key], cur[key]
+        if b == c:
+            continue
+        changed += 1
+        d = direction(key)
+        if d == "neutral" or b == 0:
+            continue
+        rel = (c - b) / abs(b)
+        worse = rel < -threshold if d == "higher" else rel > threshold
+        better = rel > threshold if d == "higher" else rel < -threshold
+        row = {"key": key, "direction": d, "baseline": b, "current": c,
+               "change_pct": round(rel * 100, 1)}
+        if worse:
+            regressions.append(row)
+        elif better:
+            improvements.append(row)
+    return {
+        "compared": len(set(cur) & set(base)),
+        "changed": changed, "only_current": len(set(cur) - set(base)),
+        "regressions": regressions, "improvements": improvements,
+    }
+
+
+def compare_file(relpath: str, ref: str, threshold: float) -> dict:
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _flatten(_load(f.read()))
+    base = _flatten(_load(base_text))
+    return {"file": relpath, **diff_flat(base, cur, threshold)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff fresh bench/workload JSON against the last "
+                    "committed round")
+    ap.add_argument("--files", nargs="+", default=list(DEFAULT_FILES))
+    ap.add_argument("--ref", default="HEAD",
+                    help="git ref holding the baseline (default HEAD)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative change that counts as a regression "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--advisory", action="store_true",
+                    help="always exit 0; print the table only "
+                         "(how tier-1 invokes it)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    reports = [compare_file(f, args.ref, args.threshold)
+               for f in args.files]
+    n_reg = sum(len(r.get("regressions", ())) for r in reports)
+    summary = {"ref": args.ref, "threshold": args.threshold,
+               "advisory": args.advisory, "total_regressions": n_reg,
+               "files": reports}
+    if args.as_json:
+        print(json.dumps(summary, indent=2))
+    else:
+        for r in reports:
+            if "skipped" in r:
+                print(f"{r['file']}: skipped ({r['skipped']})")
+                continue
+            print(f"{r['file']}: {r['compared']} shared keys, "
+                  f"{r['changed']} changed, "
+                  f"{len(r['regressions'])} regression(s), "
+                  f"{len(r['improvements'])} improvement(s)")
+            for row in r["regressions"]:
+                print("  REGRESSION %-52s %12g -> %-12g (%+.1f%%, %s-better)"
+                      % (row["key"], row["baseline"], row["current"],
+                         row["change_pct"], row["direction"]))
+            for row in r["improvements"]:
+                print("  improved   %-52s %12g -> %-12g (%+.1f%%)"
+                      % (row["key"], row["baseline"], row["current"],
+                         row["change_pct"]))
+        verdict = ("ADVISORY — not gating" if args.advisory
+                   else ("FAIL" if n_reg else "OK"))
+        print(f"bench_compare: {n_reg} regression(s) past "
+              f"{args.threshold:.0%} vs {args.ref} [{verdict}]")
+    if args.advisory:
+        return 0
+    return 1 if n_reg else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

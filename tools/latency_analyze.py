@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Per-transaction latency waterfall from tx.lifecycle trace records.
+
+Merges one or more per-node trace sinks (utils/traceview.py does the
+clock alignment), groups ``tx.lifecycle`` records by tx hash, and
+decomposes each sampled tx's end-to-end commit latency into the
+7-stage waterfall defined by utils/txlife.py's boundary chain:
+
+    admit_wait     arrival          -> verify_start
+    verify         verify_start     -> verify_end
+    app_check      verify_end       -> insert
+    proposal_wait  insert           -> reap
+    consensus      reap             -> precommit_quorum
+    apply          precommit_quorum -> commit
+    notify         commit           -> notify
+
+For each stage the report carries n/p50/p99 (ms) plus the exemplar tx
+hash behind the stage's p99 — the hash to grep in the sinks (or feed
+``dump_trace?name=tx.lifecycle``) for the concrete slow trace. The
+p99-dominant stage is named, and the stage p50s are cross-checked
+against the measured end-to-end p50: the boundary chain telescopes, so
+the sum of stage medians must reconcile with the median arrival->notify
+latency within tolerance (default 15%) — if it doesn't, stamps are
+missing or clock alignment is off, and the waterfall is lying.
+
+Within one process a stage delta uses the emitter's ``mono``
+perf_counter values (exact); across processes it falls back to the
+skew-aligned wall clock. Only COMPLETE chains (all 8 boundaries seen
+somewhere in the merged world) enter the statistics: partial chains
+(txs in flight at shutdown, rejected txs) are counted and reported but
+cannot contribute an unbiased waterfall.
+
+Usage:
+    python tools/latency_analyze.py <sink.jsonl | dir> [...] \
+        [--json] [--tolerance 0.15]
+
+Importable: ``analyze(paths, tolerance=0.15) -> dict`` (tools/txload.py
+calls it in-process before tearing down its world).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.utils import traceview  # noqa: E402
+from cometbft_tpu.utils.txlife import BOUNDARIES  # noqa: E402
+
+# (waterfall label, start boundary, end boundary) — consecutive pairs of
+# the telescoping boundary chain, so per-tx stage spans sum exactly to
+# the arrival->notify end-to-end latency.
+STAGES = tuple(
+    (label, BOUNDARIES[i], BOUNDARIES[i + 1])
+    for i, label in enumerate((
+        "admit_wait", "verify", "app_check", "proposal_wait",
+        "consensus", "apply", "notify",
+    ))
+)
+
+
+def _pct(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+
+def _earliest_per_stage(records: list[dict]) -> dict[str, dict]:
+    """stage -> the earliest (aligned) record stamping it. Multiple
+    nodes stamp the same stage for the same tx (arrival on every node a
+    gossip copy reached); the first crossing is the one the waterfall
+    wants."""
+    out: dict[str, dict] = {}
+    for r in records:
+        st = r.get("stage")
+        if st and (st not in out or r["_t"] < out[st]["_t"]):
+            out[st] = r
+    return out
+
+
+def _delta_s(a: dict, b: dict) -> float:
+    """Seconds from record a to record b: exact mono clock when both
+    came from the same process, aligned wall clock otherwise."""
+    if (a.get("_node") == b.get("_node") and a.get("pid") == b.get("pid")
+            and a.get("mono") is not None and b.get("mono") is not None):
+        return float(b["mono"]) - float(a["mono"])
+    return float(b["_t"]) - float(a["_t"])
+
+
+def analyze(paths, tolerance: float = 0.15) -> dict:
+    """Merge sinks under `paths` and build the stage-waterfall report."""
+    mt = traceview.merge(paths)
+    lifecycles = mt.tx_lifecycles()
+    stage_samples: dict[str, list[tuple[float, str]]] = {
+        label: [] for label, _s, _e in STAGES}
+    e2e: list[tuple[float, str]] = []
+    commit_e2e: list[float] = []
+    complete = 0
+    for tx, recs in lifecycles.items():
+        by_stage = _earliest_per_stage(recs)
+        if any(b not in by_stage for b in BOUNDARIES):
+            continue
+        complete += 1
+        for label, s0, s1 in STAGES:
+            d = _delta_s(by_stage[s0], by_stage[s1])
+            if d >= 0:
+                stage_samples[label].append((d, tx))
+        e2e.append((_delta_s(by_stage["arrival"], by_stage["notify"]), tx))
+        commit_e2e.append(_delta_s(by_stage["arrival"], by_stage["commit"]))
+
+    stages_rep: dict[str, dict] = {}
+    dominant = None
+    for label, _s0, _s1 in STAGES:
+        samples = sorted(stage_samples[label])
+        if not samples:
+            stages_rep[label] = {"n": 0}
+            continue
+        vals = [v for v, _tx in samples]
+        p99_v, p99_tx = samples[min(len(samples) - 1,
+                                    int(0.99 * len(samples)))]
+        stages_rep[label] = {
+            "n": len(vals),
+            "p50_ms": round(_pct(vals, 0.50) * 1e3, 3),
+            "p99_ms": round(p99_v * 1e3, 3),
+            "p99_exemplar_tx": p99_tx,
+        }
+        if dominant is None or p99_v * 1e3 > stages_rep[dominant]["p99_ms"]:
+            dominant = label
+
+    rep: dict = {
+        "sinks": len(mt.traces),
+        "txs_sampled": len(lifecycles),
+        "txs_complete": complete,
+        "stages": stages_rep,
+        "dominant_stage_p99": dominant,
+    }
+    if e2e:
+        e2e.sort()
+        e_vals = [v for v, _tx in e2e]
+        commit_e2e.sort()
+        rep["e2e_ms"] = {
+            "p50": round(_pct(e_vals, 0.50) * 1e3, 3),
+            "p99": round(_pct(e_vals, 0.99) * 1e3, 3),
+            "p99_exemplar_tx": e2e[min(len(e2e) - 1,
+                                       int(0.99 * len(e2e)))][1],
+        }
+        rep["commit_e2e_ms"] = {
+            "p50": round(_pct(commit_e2e, 0.50) * 1e3, 3),
+            "p99": round(_pct(commit_e2e, 0.99) * 1e3, 3),
+        }
+        # telescoping cross-check: sum of stage medians vs median e2e
+        sum_p50 = sum(
+            stages_rep[label].get("p50_ms", 0.0) for label, _s, _e in STAGES)
+        e2e_p50 = rep["e2e_ms"]["p50"]
+        rel = abs(sum_p50 - e2e_p50) / e2e_p50 if e2e_p50 > 0 else 0.0
+        rep["reconciliation"] = {
+            "sum_stage_p50_ms": round(sum_p50, 3),
+            "e2e_p50_ms": e2e_p50,
+            "relative_error": round(rel, 4),
+            "tolerance": tolerance,
+            "within_tolerance": rel <= tolerance,
+        }
+    return rep
+
+
+def render(rep: dict) -> str:
+    lines = [
+        "tx latency waterfall: %d sampled tx(s), %d complete chain(s) "
+        "from %d sink(s)" % (
+            rep["txs_sampled"], rep["txs_complete"], rep["sinks"]),
+    ]
+    if not rep["txs_complete"]:
+        lines.append("  (no complete lifecycle chains — nothing to "
+                     "decompose; is sampling or tracing off?)")
+        return "\n".join(lines)
+    lines.append("  %-14s %6s %10s %10s  %s" % (
+        "stage", "n", "p50_ms", "p99_ms", "p99 exemplar tx"))
+    for label, _s, _e in STAGES:
+        st = rep["stages"][label]
+        if not st["n"]:
+            lines.append("  %-14s %6d %10s %10s" % (label, 0, "-", "-"))
+            continue
+        mark = "  <-- dominant" if label == rep["dominant_stage_p99"] else ""
+        lines.append("  %-14s %6d %10.3f %10.3f  %s%s" % (
+            label, st["n"], st["p50_ms"], st["p99_ms"],
+            st["p99_exemplar_tx"], mark))
+    e = rep.get("e2e_ms")
+    if e:
+        lines.append("  %-14s %6s %10.3f %10.3f  %s" % (
+            "e2e (notify)", "", e["p50"], e["p99"], e["p99_exemplar_tx"]))
+        c = rep["commit_e2e_ms"]
+        lines.append("  %-14s %6s %10.3f %10.3f" % (
+            "e2e (commit)", "", c["p50"], c["p99"]))
+    rec = rep.get("reconciliation")
+    if rec:
+        lines.append(
+            "  reconciliation: sum of stage p50s %.3f ms vs e2e p50 "
+            "%.3f ms (%.1f%% off, tolerance %.0f%%) -> %s" % (
+                rec["sum_stage_p50_ms"], rec["e2e_p50_ms"],
+                rec["relative_error"] * 100, rec["tolerance"] * 100,
+                "OK" if rec["within_tolerance"] else "MISMATCH"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="decompose sampled per-tx commit latency into the "
+                    "lifecycle stage waterfall")
+    ap.add_argument("paths", nargs="+",
+                    help="trace sinks (.jsonl) or runner directories")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="reconciliation tolerance (default 0.15)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    rep = analyze(args.paths, tolerance=args.tolerance)
+    if args.as_json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(render(rep))
+    if not rep["txs_complete"]:
+        return 1
+    rec = rep.get("reconciliation")
+    return 0 if (rec is None or rec["within_tolerance"]) else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,6 +13,11 @@ should be dominated by consensus timeouts, not the tracer.
 
 Prints a JSON summary; exits 1 when the traced world is more than 5%
 slower than baseline.
+
+`--lifecycle` measures the tx lifecycle observatory instead: both runs
+keep trace sinks ON, the baseline disables hash-prefix tx sampling
+(COMETBFT_TPU_TXLIFE=0) and the compare run uses the production default
+rate (1/64) — isolating the sampler's own cost from the recorder's.
 """
 
 from __future__ import annotations
@@ -41,7 +46,15 @@ def _world(nodes: int, height: int, timeout_s: float) -> Manifest:
 
 
 def _run_once(nodes: int, height: int, timeout_s: float,
-              trace: bool) -> dict:
+              trace: bool, txlife_rate: int | None = None) -> dict:
+    if txlife_rate is not None:
+        # both paths: env for subprocess node inheritance, configure()
+        # for in-process worlds where txlife was imported long ago
+        os.environ["COMETBFT_TPU_TXLIFE"] = str(txlife_rate)
+        from cometbft_tpu.utils import txlife
+
+        txlife.configure(txlife_rate)
+        txlife.reset()
     workdir = tempfile.mkdtemp(prefix="trace-overhead-")
     r = Runner(_world(nodes, height, timeout_s), workdir, trace=trace)
     try:
@@ -74,19 +87,30 @@ def main(argv=None) -> int:
                          "(suppresses scheduler noise)")
     ap.add_argument("--timeout", type=float, default=150.0)
     ap.add_argument("--budget-pct", type=float, default=5.0)
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="measure tx lifecycle sampling (1/64 vs off) "
+                         "instead of the trace sinks themselves; both "
+                         "runs keep sinks on")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
+    if args.lifecycle:
+        base_kw = {"trace": True, "txlife_rate": 0}
+        cmp_kw = {"trace": True, "txlife_rate": 64}
+    else:
+        base_kw = {"trace": False}
+        cmp_kw = {"trace": True}
     results = {"baseline": [], "traced": []}
     for _ in range(args.runs):
         results["baseline"].append(
-            _run_once(args.nodes, args.height, args.timeout, trace=False))
+            _run_once(args.nodes, args.height, args.timeout, **base_kw))
         results["traced"].append(
-            _run_once(args.nodes, args.height, args.timeout, trace=True))
+            _run_once(args.nodes, args.height, args.timeout, **cmp_kw))
     base = max(r["blocks_per_s"] for r in results["baseline"])
     traced = max(r["blocks_per_s"] for r in results["traced"])
     degradation_pct = round((1.0 - traced / base) * 100.0, 2)
     summary = {
+        "mode": "lifecycle" if args.lifecycle else "trace",
         "nodes": args.nodes, "target_height": args.height,
         "baseline_blocks_per_s": base, "traced_blocks_per_s": traced,
         "degradation_pct": degradation_pct,

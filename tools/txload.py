@@ -39,7 +39,7 @@ sys.path.insert(0, REPO)
 
 
 def _build_node(home: str, mode: str, window: int, delay_ms: float,
-                signed: bool):
+                signed: bool, lifecycle_rate: int | None = None):
     from cometbft_tpu.abci.kvstore import KVStoreApp
     from cometbft_tpu.config import Config
     from cometbft_tpu.node import Node
@@ -110,14 +110,25 @@ def _build_node(home: str, mode: str, window: int, delay_ms: float,
     # native single-verify per tx, batched mode one batch verify per
     # window — the comparison the PROFILE round records
     cfg.mempool.admission_verify_sigs = signed
+    if lifecycle_rate is not None:
+        # trace sink inside the tempdir home -> tx.lifecycle records land
+        # where run() can feed them to latency_analyze before teardown
+        cfg.instrumentation.trace_sink = "data/trace.jsonl"
+        cfg.instrumentation.txlife_sample_rate = lifecycle_rate
     app = CountingKVStore()
     return Node(cfg, app=app), app
 
 
 def run(mode: str, clients: int, duration_s: float, window: int,
-        delay_ms: float, signed: bool) -> dict:
+        delay_ms: float, signed: bool,
+        lifecycle_rate: int | None = None) -> dict:
     home = tempfile.mkdtemp(prefix="txload-")
-    node, app = _build_node(home, mode, window, delay_ms, signed)
+    if lifecycle_rate is not None:
+        from cometbft_tpu.utils import txlife as _txlife
+
+        _txlife.reset()
+    node, app = _build_node(home, mode, window, delay_ms, signed,
+                            lifecycle_rate)
     from cometbft_tpu.rpc.client import LocalClient
 
     priv = None
@@ -209,6 +220,20 @@ def run(mode: str, clients: int, duration_s: float, window: int,
     coll.join(timeout=2)
     height = node.consensus.sm_state.last_block_height
     node.stop()
+    waterfall = None
+    if lifecycle_rate is not None:
+        # flush + close the sink, decompose it, THEN drop the tempdir
+        from cometbft_tpu.utils import trace as _trace
+
+        _trace.disable()
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import latency_analyze
+
+        try:
+            waterfall = latency_analyze.analyze(
+                [os.path.join(home, "data", "trace.jsonl")])
+        except Exception as e:  # noqa: BLE001 — report, don't crash load
+            waterfall = {"error": str(e)}
     shutil.rmtree(home, ignore_errors=True)
 
     lat_ms = sorted(x * 1e3 for x in latencies)
@@ -219,7 +244,7 @@ def run(mode: str, clients: int, duration_s: float, window: int,
         return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
 
     committed = counts["committed"]
-    return {
+    res = {
         "metric": "ingest_sustained_load",
         "mode": mode,
         "clients": clients,
@@ -240,6 +265,10 @@ def run(mode: str, clients: int, duration_s: float, window: int,
         "txs_per_app_call": round(
             app.txs_checked / max(app.mempool_calls, 1), 2),
     }
+    if waterfall is not None:
+        res["lifecycle_rate"] = lifecycle_rate
+        res["stage_waterfall"] = waterfall
+    return res
 
 
 def main() -> int:
@@ -252,9 +281,17 @@ def main() -> int:
     ap.add_argument("--delay-ms", type=float, default=2.0)
     ap.add_argument("--signed", action="store_true",
                     help="STX ed25519 envelopes -> batch verify stage")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="trace tx.lifecycle stages to a sink and attach "
+                         "the latency_analyze stage waterfall")
+    ap.add_argument("--lifecycle-rate", type=int, default=16,
+                    help="1/N hash-prefix sampling for --lifecycle runs "
+                         "(denser than the production default of 64 so "
+                         "short runs still get statistics)")
     args = ap.parse_args()
     res = run(args.mode, args.clients, args.duration, args.window,
-              args.delay_ms, args.signed)
+              args.delay_ms, args.signed,
+              lifecycle_rate=args.lifecycle_rate if args.lifecycle else None)
     print(json.dumps(res))
     return 0
 

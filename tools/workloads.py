@@ -648,15 +648,15 @@ def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
 
     dur = 3.0 if QUICK else duration_s
 
-    def one(mode):
+    def one(mode, extra_args=(), env_extra=None):
         script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "txload.py")
         p = subprocess.run(
             [sys.executable, script, "--mode", mode, "--signed",
              "--clients", str(clients), "--duration", str(dur),
-             "--window", str(window)],
+             "--window", str(window), *extra_args],
             capture_output=True, text=True, timeout=600,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})},
         )
         if p.returncode != 0:
             raise RuntimeError(
@@ -684,10 +684,40 @@ def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
     pertx = best("pertx")
     batched = best("batched")
 
+    # --- tx lifecycle observatory (PROFILE round 11) -------------------
+    # (a) stage-attributed commit latency: one batched run with the
+    # hash-prefix lifecycle sampler tracing to a sink, decomposed by
+    # tools/latency_analyze.py into the 7-stage waterfall
+    life = one("batched", extra_args=("--lifecycle",))
+    waterfall = life.get("stage_waterfall") or {}
+    rec_check = waterfall.get("reconciliation") or {}
+    if waterfall.get("dominant_stage_p99"):
+        print(f"  lifecycle: {waterfall['txs_complete']} chains, "
+              f"dominant stage {waterfall['dominant_stage_p99']}, "
+              f"reconciliation off by "
+              f"{rec_check.get('relative_error', 0) * 100:.1f}%",
+              file=sys.stderr)
+
+    # (b) sampling overhead: block rate with lifecycle sampling OFF vs
+    # the production default 1/64 (env wins over config in the child) —
+    # the observatory must cost <5% block rate to stay always-on
+    def block_rate(env):
+        runs = [one("batched", env_extra=env) for _ in range(reps)]
+        return max(r["height"] / max(r["duration_s"], 1e-9) for r in runs)
+
+    base_bps = block_rate({"COMETBFT_TPU_TXLIFE": "0"})
+    samp_bps = block_rate({"COMETBFT_TPU_TXLIFE": "64"})
+    overhead_pct = round(max(0.0, (base_bps - samp_bps)
+                             / max(base_bps, 1e-9) * 100), 2)
+    print(f"  lifecycle overhead: {base_bps:.2f} -> {samp_bps:.2f} "
+          f"blocks/s ({overhead_pct}%)", file=sys.stderr)
+
     gate = {
         "min_txs_per_sec": 1500.0,
         "max_p99_commit_ms": 1500.0,
         "batched_beats_pertx": True,
+        "waterfall_reconciles": True,
+        "max_lifecycle_overhead_pct": 5.0,
     }
     cores = os.cpu_count() or 1
     starved = cores < 2
@@ -717,6 +747,14 @@ def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
                 < pertx["commit_latency_ms"]["p99"]), (
             "micro-batched admission did not beat per-tx p99 latency"
         )
+        assert rec_check.get("within_tolerance"), (
+            f"stage waterfall does not reconcile with measured e2e p50: "
+            f"{rec_check}"
+        )
+        assert overhead_pct <= gate["max_lifecycle_overhead_pct"], (
+            f"lifecycle sampling costs {overhead_pct}% block rate > "
+            f"{gate['max_lifecycle_overhead_pct']}% budget"
+        )
     return {
         "metric": "ingest_sustained_load",
         "clients": clients,
@@ -734,6 +772,15 @@ def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
         "p99_improvement": round(
             pertx["commit_latency_ms"]["p99"]
             / max(batched["commit_latency_ms"]["p99"], 1e-9), 2),
+        "lifecycle_rate": life.get("lifecycle_rate"),
+        "stage_waterfall": waterfall,
+        "lifecycle_overhead": {
+            "baseline_blocks_per_sec": round(base_bps, 2),
+            "sampled_blocks_per_sec": round(samp_bps, 2),
+            "sample_rate": 64,
+            "overhead_pct": overhead_pct,
+            "budget_pct": gate["max_lifecycle_overhead_pct"],
+        },
         "gate": gate,
     }
 
