@@ -225,6 +225,32 @@ class StorageConfig:
 
 
 @dataclass
+class LightConfig:
+    """Light-client streaming service (light/serve.py, ROADMAP #2).
+
+    When `serve` is on, the node maintains an MMR accumulator over
+    committed headers, exposes light_status/light_mmr_proof/light_bisect
+    routes, and streams header+proof payloads at /light_stream. The
+    verified-commit cache amortizes each height's batch verify across
+    all subscribers."""
+
+    serve: bool = False
+    # verified-commit cache entries (heights) kept resident
+    cache_size: int = 4096
+    # per-subscriber payload queue bound; overflow drops oldest
+    subscriber_queue: int = 4096
+    # persist the MMR accumulator in the light column of the node DB
+    # (mem-backed nodes rebuild from the block store on restart)
+    persist_mmr: bool = True
+
+    def validate(self) -> None:
+        if self.cache_size <= 0:
+            raise ValueError("light.cache_size must be positive")
+        if self.subscriber_queue <= 0:
+            raise ValueError("light.subscriber_queue must be positive")
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -271,6 +297,7 @@ class Config:
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    light: LightConfig = field(default_factory=LightConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
@@ -278,7 +305,7 @@ class Config:
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
-                        self.instrumentation):
+                        self.light, self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------
@@ -318,6 +345,7 @@ class Config:
             emit("blocksync", self.blocksync),
             emit("statesync", self.statesync),
             emit("storage", self.storage),
+            emit("light", self.light),
             emit("instrumentation", self.instrumentation),
         ]
         return "\n\n".join(parts) + "\n"
@@ -355,6 +383,7 @@ class Config:
             blocksync=mk(BlockSyncConfig, d.get("blocksync", {})),
             statesync=mk(StateSyncConfig, d.get("statesync", {})),
             storage=mk(StorageConfig, d.get("storage", {})),
+            light=mk(LightConfig, d.get("light", {})),
             instrumentation=mk(InstrumentationConfig,
                                d.get("instrumentation", {})),
         )

@@ -15,8 +15,11 @@ from .client import (
     Provider,
     ProviderError,
     StoreProvider,
+    verify_ancestry,
 )
-from .store import LightStore
+from .mmr import MMR, MMRProof
+from .serve import LightServe, StreamSubscriber, VerifiedCommitCache
+from .store import LightStore, MMRStore
 
 __all__ = [
     "ErrConflictingHeaders",
@@ -31,8 +34,15 @@ __all__ = [
     "verify_adjacent",
     "verify_non_adjacent",
     "verify_stream",
+    "verify_ancestry",
     "LightClient",
     "Provider",
     "StoreProvider",
     "LightStore",
+    "MMR",
+    "MMRProof",
+    "MMRStore",
+    "LightServe",
+    "StreamSubscriber",
+    "VerifiedCommitCache",
 ]

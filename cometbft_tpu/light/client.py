@@ -28,6 +28,24 @@ from .verifier import (
 )
 
 
+def verify_ancestry(root: bytes, size: int, base_height: int, height: int,
+                    header_hash: bytes, proof) -> bool:
+    """Check a light-serve MMR ancestry proof: the header at `height`
+    is leaf (height - base_height) of the accumulator snapshot with the
+    given root and leaf count. `proof` may be an MMRProof or its
+    encoded bytes (as served in /light_stream payloads)."""
+    from .mmr import MMRProof
+
+    if isinstance(proof, (bytes, bytearray)):
+        try:
+            proof = MMRProof.decode(bytes(proof))
+        except Exception:  # noqa: BLE001 — malformed wire form
+            return False
+    if proof.size != size or proof.leaf_index != height - base_height:
+        return False
+    return proof.verify(root, header_hash)
+
+
 class Provider(ABC):
     """Source of light blocks (reference light/provider/provider.go)."""
 

@@ -9,9 +9,17 @@ everything; this just fails fast on a lying provider). Also carries
 report_evidence: the detector submits attack evidence back to providers
 through the broadcast_evidence route (reference
 light/provider/http ReportEvidence).
+
+Transport failures are retried with exponential backoff (reference
+http.go's retry loop around signedHeader/validatorSet) and each request
+carries the provider's timeout. Only TRANSPORT faults retry — a
+response that decodes but fails the validator-hash sanity check is a
+lying provider, re-asking cannot fix it, and it raises immediately.
 """
 
 from __future__ import annotations
+
+import time
 
 from ..rpc.client import HTTPClient
 from ..rpc.codec import commit_from_json, header_from_json, validator_set_from_json
@@ -20,11 +28,16 @@ from .types import LightBlock, SignedHeader
 
 
 class HTTPProvider(Provider):
-    def __init__(self, chain_id: str, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, chain_id: str, base_url: str, timeout_s: float = 10.0,
+                 retries: int = 3, backoff_s: float = 0.05,
+                 backoff_factor: float = 2.0):
         self._chain_id = chain_id
-        self.client = HTTPClient(base_url)
+        self.client = HTTPClient(base_url, timeout=timeout_s)
         self.base_url = base_url
         self.timeout_s = timeout_s
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
 
     def __repr__(self):
         return f"HTTPProvider({self.base_url})"
@@ -32,12 +45,26 @@ class HTTPProvider(Provider):
     def chain_id(self) -> str:
         return self._chain_id
 
+    def _call(self, method: str, params: dict):
+        """One RPC with retry-with-backoff on transport/RPC failure."""
+        delay = self.backoff_s
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self.client.call(method, params, timeout=self.timeout_s)
+            except Exception as e:  # noqa: BLE001 — network/RPC failure
+                last = e
+                if attempt < self.retries:
+                    time.sleep(delay)
+                    delay *= self.backoff_factor
+        raise ProviderError(
+            f"{self.base_url}: {method} failed after "
+            f"{self.retries + 1} attempts: {last}"
+        ) from last
+
     def light_block(self, height: int) -> LightBlock | None:
-        try:
-            c = self.client.call("commit", {"height": str(height)})
-            v = self.client.call("validators", {"height": str(height)})
-        except Exception as e:  # noqa: BLE001 — network/RPC failure
-            raise ProviderError(f"{self.base_url}: {e}") from e
+        c = self._call("commit", {"height": str(height)})
+        v = self._call("validators", {"height": str(height)})
         sh = c.get("signed_header") or {}
         header = header_from_json(sh.get("header") or {})
         commit = commit_from_json(sh.get("commit") or {})
@@ -53,4 +80,4 @@ class HTTPProvider(Provider):
 
     def report_evidence(self, ev) -> None:
         # wrapped(): the tagged oneof form decode_evidence expects
-        self.client.call("broadcast_evidence", {"evidence": ev.wrapped().hex()})
+        self._call("broadcast_evidence", {"evidence": ev.wrapped().hex()})

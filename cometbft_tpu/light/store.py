@@ -97,3 +97,66 @@ class LightStore:
             for h in drop:
                 self._db.delete(_key(h))
             return len(drop)
+
+
+def _mmr_node_key(pos: int) -> bytes:
+    return b"MMRN:" + pos.to_bytes(8, "big")
+
+
+_MMR_SIZE_KEY = b"MMRS:"  # leaf_count_be8 || node_count_be8
+_MMR_BASE_KEY = b"MMRB:"  # base chain height of leaf 0, be8
+
+
+class MMRStore:
+    """KV persistence for the light-serve MMR accumulator.
+
+    Write-through from `MMR.append` (only the nodes the append created
+    are written), rebuilt into memory via `MMR.load`. The size record is
+    written after the node records, so a crash between them leaves a
+    consistent prefix — every MMR node-array prefix is itself a valid
+    MMR.
+    """
+
+    def __init__(self, db: KVStore | None = None):
+        self._db = db or MemKV()
+        self._lock = threading.Lock()
+
+    def append_nodes(self, first_pos: int, nodes: list[bytes],
+                     leaf_count: int) -> None:
+        with self._lock:
+            for i, node in enumerate(nodes):
+                self._db.set(_mmr_node_key(first_pos + i), node)
+            self._db.set(
+                _MMR_SIZE_KEY,
+                leaf_count.to_bytes(8, "big")
+                + (first_pos + len(nodes)).to_bytes(8, "big"),
+            )
+
+    def load_nodes(self) -> tuple[int, list[bytes]]:
+        with self._lock:
+            raw = self._db.get(_MMR_SIZE_KEY)
+            if not raw:
+                return 0, []
+            leaf_count = int.from_bytes(raw[:8], "big")
+            node_count = int.from_bytes(raw[8:16], "big")
+            nodes = []
+            for pos in range(node_count):
+                node = self._db.get(_mmr_node_key(pos))
+                if node is None:
+                    raise ValueError(f"mmr store missing node {pos}")
+                nodes.append(node)
+            return leaf_count, nodes
+
+    def node_count(self) -> int:
+        with self._lock:
+            raw = self._db.get(_MMR_SIZE_KEY)
+        return int.from_bytes(raw[8:16], "big") if raw else 0
+
+    def save_base_height(self, height: int) -> None:
+        with self._lock:
+            self._db.set(_MMR_BASE_KEY, height.to_bytes(8, "big"))
+
+    def load_base_height(self) -> int | None:
+        with self._lock:
+            raw = self._db.get(_MMR_BASE_KEY)
+        return int.from_bytes(raw, "big") if raw else None

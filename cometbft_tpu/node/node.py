@@ -222,6 +222,29 @@ class Node:
         self.pruner = Pruner(self.block_store, self.state_store)
         self.executor.pruner = self.pruner
 
+        # --- light-client serving surface ------------------------------
+        self.light_serve = None
+        if config.light.serve:
+            from ..light import LightServe, MMRStore
+
+            mmr_store = None
+            if config.light.persist_mmr:
+                mmr_store = MMRStore(
+                    open_kv(None if mem else _p("data/light_mmr.db"))
+                )
+            self.light_serve = LightServe(
+                self.genesis_doc.chain_id,
+                self.block_store,
+                self.state_store,
+                backend=config.base.crypto_backend,
+                cache_size=config.light.cache_size,
+                subscriber_queue=config.light.subscriber_queue,
+                mmr_store=mmr_store,
+            )
+            # executor event handler: fires on consensus commits AND
+            # blocksync replay, so the accumulator never misses a height
+            self.executor.event_handlers.append(self.light_serve.on_commit)
+
         # --- consensus -------------------------------------------------
         self.wal = WAL(_p(config.consensus.wal_file))
         self.consensus = ConsensusState(
@@ -329,6 +352,7 @@ class Node:
             node_info=info,
             evidence_pool=self.evidence_pool,
             consensus_reactor=self.consensus_reactor,
+            light_serve=self.light_serve,
         )
         self.rpc_server = None
         self.grpc_server = None
@@ -549,6 +573,8 @@ class Node:
         self.consensus.stop()
         self.mempool.close()  # admission drainer + gossip notifier
         self.pruner.stop()
+        if self.light_serve is not None:
+            self.light_serve.stop()  # closes subscriber queues
         if self.pex_reactor is not None:
             self.pex_reactor.stop()  # also persists the address book
         self.consensus_reactor.stop()

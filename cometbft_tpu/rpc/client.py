@@ -7,11 +7,13 @@ import urllib.request
 
 
 class HTTPClient:
-    def __init__(self, base_url: str):
+    def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
         self._id = 0
 
-    def call(self, method: str, params: dict | None = None):
+    def call(self, method: str, params: dict | None = None,
+             timeout: float | None = None):
         self._id += 1
         body = json.dumps({
             "jsonrpc": "2.0", "id": self._id, "method": method,
@@ -21,7 +23,8 @@ class HTTPClient:
             self.base_url, data=body,
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        t = self.timeout if timeout is None else timeout
+        with urllib.request.urlopen(req, timeout=t) as resp:
             out = json.loads(resp.read())
         if "error" in out:
             raise RuntimeError(f"rpc error: {out['error']}")

@@ -28,10 +28,11 @@ class Env:
                  mempool=None, switch=None, event_bus=None, tx_indexer=None,
                  block_indexer=None, genesis_doc=None, app_conns=None,
                  node_info=None, evidence_pool=None, pex_reactor=None,
-                 consensus_reactor=None):
+                 consensus_reactor=None, light_serve=None):
         self.evidence_pool = evidence_pool
         self.pex_reactor = pex_reactor
         self.consensus_reactor = consensus_reactor
+        self.light_serve = light_serve
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -778,6 +779,97 @@ def unsafe_flush_mempool(env, params):
     return {}
 
 
+def _light_serve(env):
+    if env.light_serve is None:
+        raise RPCError(-32603, "light serving surface disabled "
+                               "(config [light] serve = false)")
+    return env.light_serve
+
+
+def _validator_set_json(vals) -> dict:
+    return {
+        "validators": [
+            {
+                "address": _hx(v.address),
+                "pub_key": _hx(v.pub_key.bytes()),
+                "pub_key_type": v.pub_key.type_tag(),
+                "voting_power": str(v.voting_power),
+                "proposer_priority": str(v.proposer_priority),
+            }
+            for v in vals.validators
+        ],
+    }
+
+
+def _light_block_json(lb) -> dict:
+    return {
+        "signed_header": {
+            "header": _header_json(lb.signed_header.header),
+            "commit": _commit_json(lb.signed_header.commit),
+        },
+        "validator_set": _validator_set_json(lb.validators),
+    }
+
+
+def light_status(env, params):
+    """Serving-surface introspection: accumulator root/size, subscriber
+    count, cache hit/miss totals, per-height verify amortization."""
+    srv = _light_serve(env)
+    st = srv.stats()
+    st["base_height"] = str(st["base_height"] or 0)
+    st["heights_served"] = str(st["heights_served"])
+    return st
+
+
+def light_mmr_proof(env, params):
+    """MMR ancestry proof for one committed height against the current
+    accumulator snapshot; the client re-binds it to a header hash it
+    trusts (see light.client.verify_ancestry)."""
+    srv = _light_serve(env)
+    try:
+        h = int(params.get("height", 0))
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, f"invalid height: {params.get('height')}") from e
+    try:
+        proof = srv.ancestry_proof(h)
+    except IndexError as e:
+        raise RPCError(-32603, str(e)) from e
+    size, root = srv.mmr_snapshot()
+    return {
+        "height": str(h),
+        "base_height": str(srv.base_height),
+        "leaf_index": str(proof.leaf_index),
+        "mmr_size": str(size),
+        "mmr_root": _hx(root),
+        "proof": proof.encode().hex(),
+        "proof_bytes": proof.num_bytes(),
+    }
+
+
+def light_bisect(env, params):
+    """Server-side skipping verification: the minimal pivot chain from a
+    client's trusted height to the target under validator-set churn.
+    Every pivot's commit is verified through the shared cache, so the
+    per-height batch verify is paid once regardless of how many clients
+    ask."""
+    srv = _light_serve(env)
+    try:
+        trusted = int(params.get("trusted_height", 0))
+        target = int(params.get("height", 0))
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, "invalid trusted_height/height") from e
+    try:
+        pivots = srv.bisect(trusted, target)
+    except (ValueError, KeyError) as e:
+        raise RPCError(-32603, str(e)) from e
+    return {
+        "trusted_height": str(trusted),
+        "target_height": str(target),
+        "pivots": [_light_block_json(lb) for lb in pivots],
+        "pivot_heights": [str(lb.height) for lb in pivots],
+    }
+
+
 # unsafe operator routes, served only when rpc.unsafe is enabled
 # (reference rpc/core/routes.go AddUnsafeRoutes gated by config Unsafe)
 UNSAFE_ROUTES = {
@@ -816,4 +908,7 @@ ROUTES = {
     "tx": tx,
     "tx_search": tx_search,
     "block_search": block_search,
+    "light_status": light_status,
+    "light_mmr_proof": light_mmr_proof,
+    "light_bisect": light_bisect,
 }

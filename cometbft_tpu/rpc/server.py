@@ -89,6 +89,8 @@ class RPCServer:
                 u = urlparse(self.path)
                 method = u.path.strip("/")
                 params = dict(parse_qsl(u.query))
+                if method == "light_stream":
+                    return self._light_stream(params)
                 # URI params arrive as "5" (quoted) or 0xABC (hex) per the
                 # reference's URI style; normalize both so handlers that
                 # do bytes.fromhex / int() see plain values. The 0x strip
@@ -135,6 +137,46 @@ class RPCServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            # ---- light-client streaming ----------------------------
+            def _light_stream(self, params):
+                """GET /light_stream: chunked-transfer JSONL of committed
+                header payloads (height/hash/mmr root+proof), one line
+                per height, pushed as consensus commits. Optional
+                ``limit=N`` closes the stream after N payloads (load
+                generators and tests); ``timeout_s`` caps how long the
+                stream waits for the next commit (default 30 s)."""
+                srv = getattr(outer.env, "light_serve", None)
+                if srv is None:
+                    body = json.dumps({"error": "light serving disabled"}
+                                      ).encode()
+                    return self._write(503, body)
+                limit = int(params.get("limit", 0) or 0)
+                timeout_s = float(params.get("timeout_s", 30.0) or 30.0)
+                sub_id, sub = srv.subscribe()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/jsonl; charset=utf-8")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    sent = 0
+                    while not limit or sent < limit:
+                        payload = sub.pop(timeout=timeout_s)
+                        if payload is None:
+                            break
+                        line = (json.dumps(payload) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        )
+                        self.wfile.flush()
+                        sent += 1
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass  # client went away mid-stream
+                finally:
+                    srv.unsubscribe(sub_id)
 
             # ---- websocket subscriptions ---------------------------
             def _websocket(self):

@@ -421,6 +421,8 @@ class StateSyncMetrics:
 
 
 class LightClientMetrics:
+    PROOF_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
     def __init__(self, reg: Registry | None = None):
         reg = reg or DEFAULT_REGISTRY
         self.headers_verified_total = reg.counter(
@@ -429,6 +431,23 @@ class LightClientMetrics:
         self.bisections_total = reg.counter(
             "light", "bisections_total",
             "Bisection steps taken during skipping verification")
+        self.serve_subscribers = reg.gauge(
+            "light", "serve_subscribers",
+            "Live /light_stream subscribers on the serving surface")
+        self.verify_cache_hits_total = reg.counter(
+            "light", "verify_cache_hits_total",
+            "Verified-commit cache hits (fan-out amortized over one "
+            "VerifyCommitLight per height)")
+        self.verify_cache_misses_total = reg.counter(
+            "light", "verify_cache_misses_total",
+            "Verified-commit cache misses (each pays one batch verify)")
+        self.proof_bytes = reg.histogram(
+            "light", "proof_bytes",
+            "Encoded MMR ancestry proof sizes served to light clients",
+            buckets=self.PROOF_BUCKETS)
+        self.stream_dropped_total = reg.counter(
+            "light", "stream_dropped_total",
+            "Stream payloads dropped oldest-first on slow subscribers")
 
 
 class CryptoMetrics:

@@ -273,6 +273,8 @@ SPAN_REGISTRY = {
     "tx.lifecycle": "one stage crossing of a sampled tx (tx/stage/mono; utils/txlife.py — hash-prefix sampled, correlated across nodes by tx)",
     "p2p.send": "consensus wire message handed to a peer (msg/height/round/peer)",
     "p2p.recv": "consensus wire message received from a peer (msg/height/round/peer)",
+    "light.mmr_append": "one committed header folded into the MMR accumulator (height/leaf/size/dur_ms)",
+    "light.serve_proof": "one MMR ancestry proof generated for a light client (height/size/bytes)",
 }
 
 

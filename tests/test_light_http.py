@@ -300,3 +300,138 @@ def test_bootstrap_state_offline(tmp_path):
     finally:
         n0.stop()
         n1.stop()
+
+
+# -- flaky-server retry differential -------------------------------------
+#
+# HTTPProvider retries TRANSPORT/RPC faults with backoff; a provider
+# that answers but lies (validator set does not hash to the header)
+# must fail immediately. The fixture serves the real route table over a
+# wrapper that injects faults for the first N dispatches.
+
+FLAKY_CHAIN = "flaky-light-chain"
+
+
+class _FlakyRoutes:
+    """Route-table wrapper: the first `fail_first` dispatches raise, the
+    rest (optionally tampered) delegate to the real handlers."""
+
+    def __init__(self, env_routes, fail_first=0, tamper=None):
+        self._routes = env_routes
+        self.remaining = fail_first
+        self.tamper = tamper  # fn(method, result) -> result
+        self.calls = {}  # method -> dispatch count
+
+    def get(self, method):
+        fn = self._routes.get(method)
+        if fn is None:
+            return None
+
+        def wrapped(env, params):
+            self.calls[method] = self.calls.get(method, 0) + 1
+            if self.remaining > 0:
+                self.remaining -= 1
+                raise RuntimeError("injected transient fault")
+            result = fn(env, params)
+            if self.tamper is not None:
+                result = self.tamper(method, result)
+            return result
+
+        return wrapped
+
+
+@pytest.fixture(scope="module")
+def flaky_chain():
+    from cometbft_tpu.state.types import encode_validator_set
+    from cometbft_tpu.storage import MemKV, StateStore
+    from cometbft_tpu.utils.factories import make_chain
+
+    store, state, genesis, signers = make_chain(
+        8, n_validators=4, chain_id=FLAKY_CHAIN, backend="cpu"
+    )
+    ss = StateStore(MemKV())
+    for h in range(1, 10):
+        ss._db.set(
+            b"SV:" + h.to_bytes(8, "big"),
+            encode_validator_set(state.validators),
+        )
+    return store, ss
+
+
+def _flaky_server(flaky_chain, fail_first=0, tamper=None):
+    from cometbft_tpu.rpc.routes import ROUTES, Env
+    from cometbft_tpu.rpc.server import RPCServer
+
+    store, ss = flaky_chain
+    routes = _FlakyRoutes(ROUTES, fail_first=fail_first, tamper=tamper)
+    server = RPCServer(Env(block_store=store, state_store=ss),
+                       host="127.0.0.1", port=0, routes=routes)
+    server.start()
+    host, port = server.addr
+    return server, routes, f"http://{host}:{port}"
+
+
+def test_http_provider_retries_match_store_provider(flaky_chain):
+    """Differential: through a server whose first 3 dispatches fail, the
+    retrying HTTPProvider returns the same light block the in-process
+    StoreProvider does."""
+    store, ss = flaky_chain
+    server, routes, url = _flaky_server(flaky_chain, fail_first=3)
+    try:
+        hp = HTTPProvider(FLAKY_CHAIN, url, timeout_s=5.0, retries=3,
+                          backoff_s=0.001)
+        sp = StoreProvider(FLAKY_CHAIN, store, ss)
+        got, want = hp.light_block(5), sp.light_block(5)
+        assert got is not None and want is not None
+        assert got.signed_header.header.hash() == \
+            want.signed_header.header.hash()
+        assert got.signed_header.commit.height == 5
+        assert got.validators.hash() == want.validators.hash()
+        # the faults were really injected and retried through
+        assert routes.remaining == 0
+        assert sum(routes.calls.values()) > 2
+    finally:
+        server.stop()
+
+
+def test_http_provider_retries_exhausted(flaky_chain):
+    from cometbft_tpu.light.client import ProviderError
+
+    server, routes, url = _flaky_server(flaky_chain, fail_first=100)
+    try:
+        hp = HTTPProvider(FLAKY_CHAIN, url, timeout_s=5.0, retries=1,
+                          backoff_s=0.001)
+        with pytest.raises(ProviderError, match="failed after 2 attempts"):
+            hp.light_block(5)
+        # retries=0 gives up on the first fault
+        hp0 = HTTPProvider(FLAKY_CHAIN, url, timeout_s=5.0, retries=0,
+                           backoff_s=0.001)
+        before = routes.calls.get("commit", 0)
+        with pytest.raises(ProviderError, match="failed after 1 attempts"):
+            hp0.light_block(5)
+        assert routes.calls["commit"] == before + 1
+    finally:
+        server.stop()
+
+
+def test_http_provider_lying_valset_not_retried(flaky_chain):
+    """A decodable-but-wrong validator set is a lying provider, not a
+    transport fault: it raises immediately, without retry."""
+    from cometbft_tpu.light.client import ProviderError
+
+    def tamper(method, result):
+        if method == "validators":
+            result = dict(result)
+            result["validators"] = result["validators"][:-1]
+        return result
+
+    server, routes, url = _flaky_server(flaky_chain, tamper=tamper)
+    try:
+        hp = HTTPProvider(FLAKY_CHAIN, url, timeout_s=5.0, retries=3,
+                          backoff_s=0.001)
+        with pytest.raises(ProviderError, match="does not hash"):
+            hp.light_block(5)
+        assert routes.calls["validators"] == 1, \
+            "semantic mismatch must not be retried"
+    finally:
+        server.stop()

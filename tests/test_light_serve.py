@@ -1,0 +1,393 @@
+"""Light-client streaming service tests (light/serve.py).
+
+Covers: commit-hook MMR growth + stream fan-out, verified-commit cache
+single-flight under concurrent fan-out, subscriber backpressure
+drop-oldest accounting, skipping-bisection pivot minimality under
+validator-set churn, replay-skip + gap backfill, the light_* RPC routes,
+and the /light_stream chunked-JSONL HTTP endpoint.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.light import LightServe, StreamSubscriber, verify_ancestry
+from cometbft_tpu.light.types import LightBlock
+from cometbft_tpu.rpc.client import LocalClient
+from cometbft_tpu.rpc.routes import Env, RPCError
+from cometbft_tpu.rpc.server import RPCServer
+from cometbft_tpu.storage import MemKV, StateStore
+from cometbft_tpu.utils import factories as fx
+from cometbft_tpu.utils.factories import make_chain
+
+CHAIN = "light-serve-chain"
+
+
+@pytest.fixture(scope="module")
+def chain():
+    from cometbft_tpu.state.types import encode_validator_set
+
+    store, state, genesis, signers = make_chain(
+        12, n_validators=4, chain_id=CHAIN, backend="cpu"
+    )
+    ss = StateStore(MemKV())
+    for h in range(1, 14):
+        ss._db.set(
+            b"SV:" + h.to_bytes(8, "big"),
+            encode_validator_set(state.validators),
+        )
+    return store, state, ss
+
+
+def _serve(chain, feed_to=12, **kw):
+    store, state, ss = chain
+    srv = LightServe(CHAIN, store, ss, backend="cpu", **kw)
+    for h in range(1, feed_to + 1):
+        srv.on_commit(store.load_block(h))
+    return srv
+
+
+def _check_payload(p, base_height):
+    return verify_ancestry(
+        bytes.fromhex(p["mmr_root"]), p["mmr_size"], base_height,
+        p["height"], bytes.fromhex(p["hash"]),
+        bytes.fromhex(p["mmr_proof"]),
+    )
+
+
+# -- commit hook + stream fan-out ---------------------------------------
+
+
+def test_on_commit_streams_verifiable_payloads(chain):
+    store, state, ss = chain
+    srv = LightServe(CHAIN, store, ss, backend="cpu")
+    _, sub = srv.subscribe()
+    for h in range(1, 13):
+        srv.on_commit(store.load_block(h))
+    got = sub.drain()
+    assert [p["height"] for p in got] == list(range(1, 13))
+    assert srv.base_height == 1
+    size, root = srv.mmr_snapshot()
+    assert size == 12
+    for p in got:
+        assert _check_payload(p, srv.base_height), p["height"]
+    # payloads also verify against the FINAL snapshot via a fresh proof
+    proof = srv.ancestry_proof(5)
+    assert proof.verify(root, store.load_block(5).header.hash())
+    srv.stop()
+
+
+def test_on_commit_replay_skip_and_gap_backfill(chain):
+    store, state, ss = chain
+    srv = LightServe(CHAIN, store, ss, backend="cpu")
+    for h in range(1, 6):
+        srv.on_commit(store.load_block(h))
+    assert srv.mmr.leaf_count == 5
+    served = srv.heights_served
+    # blocksync replay of an already-folded height: no double-append
+    srv.on_commit(store.load_block(3))
+    assert srv.mmr.leaf_count == 5
+    assert srv.heights_served == served
+    # gap (serve missed 6..7): backfilled from the block store
+    srv.on_commit(store.load_block(8))
+    assert srv.mmr.leaf_count == 8
+    _, root = srv.mmr_snapshot()
+    for h in (6, 7, 8):
+        assert srv.ancestry_proof(h).verify(
+            root, store.load_block(h).header.hash())
+
+
+# -- verified-commit cache ----------------------------------------------
+
+
+def test_cache_single_verify_under_concurrent_fanout(chain):
+    srv = _serve(chain)
+    n_threads = 32
+    barrier = threading.Barrier(n_threads)
+    results, errors = [None] * n_threads, []
+
+    def worker(i):
+        try:
+            barrier.wait()
+            results[i] = srv.verified_commit(7)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert srv.cache.verify_calls[7] == 1, (
+        "fan-out must pay VerifyCommitLight once per height, got "
+        f"{srv.cache.verify_calls[7]}"
+    )
+    lb = results[0]
+    assert isinstance(lb, LightBlock)
+    assert all(r is lb for r in results), "waiters share the cached object"
+    # later callers hit the done-cache, still one verify
+    assert srv.verified_commit(7) is lb
+    assert srv.cache.verify_calls[7] == 1
+
+
+def test_cache_failure_not_poisoned(chain):
+    srv = _serve(chain)
+    with pytest.raises(KeyError):
+        srv.verified_commit(999)
+    # failure is not cached: the next call re-attempts (and re-fails)
+    with pytest.raises(KeyError):
+        srv.verified_commit(999)
+    assert srv.cache.verify_calls[999] == 2
+    # a good height still works afterwards
+    assert srv.verified_commit(4).height == 4
+
+
+def test_cache_lru_eviction(chain):
+    srv = _serve(chain, cache_size=3)
+    for h in (1, 2, 3, 4):
+        srv.verified_commit(h)
+    assert len(srv.cache) == 3  # height 1 evicted
+    srv.verified_commit(1)  # re-verified after eviction
+    assert srv.cache.verify_calls[1] == 2
+    assert srv.cache.verify_calls[4] == 1
+
+
+# -- subscriber backpressure --------------------------------------------
+
+
+def test_subscriber_drop_oldest_accounting():
+    sub = StreamSubscriber(limit=4)
+    for i in range(10):
+        sub.push(i)
+    assert len(sub) == 4
+    assert sub.dropped == 6
+    assert sub.drain() == [6, 7, 8, 9], "drop-oldest keeps the newest"
+    assert sub.dropped == 6
+    assert sub.pop(timeout=0.01) is None
+
+
+def test_subscriber_close_and_pop():
+    sub = StreamSubscriber(limit=4)
+    sub.push("a")
+    assert sub.pop(timeout=0.1) == "a"
+    sub.close()
+    assert sub.pop(timeout=0.1) is None
+    sub.push("ignored after close")
+    assert len(sub) == 0
+
+
+def test_serve_subscriber_overflow_counted(chain):
+    store, state, ss = chain
+    srv = LightServe(CHAIN, store, ss, backend="cpu", subscriber_queue=2)
+    _, sub = srv.subscribe()
+    for h in range(1, 8):
+        srv.on_commit(store.load_block(h))
+    assert len(sub) == 2
+    assert sub.dropped == 5
+    assert srv.stats()["stream_dropped"] == 5
+    assert [p["height"] for p in sub.drain()] == [6, 7]
+    srv.stop()
+
+
+# -- skipping bisection under validator-set churn -----------------------
+
+# per-height signer indices (6 signers, power 10 each): the trusted next
+# set at h=1 (set A) covers commits through height 5 (shares 2/3 of B's
+# power) but NOT 6+ (one or zero shared members <= 1/3) — so 1 -> 9
+# needs exactly one intermediate pivot.
+CHURN_SETS = {
+    1: (0, 1, 2), 2: (0, 1, 2), 3: (0, 1, 2), 4: (0, 1, 2),
+    5: (1, 2, 3), 6: (2, 3, 4),
+    7: (3, 4, 5), 8: (3, 4, 5), 9: (3, 4, 5), 10: (3, 4, 5),
+}
+CHURN_CHAIN = "churn-chain"
+
+
+class _StubBlockStore:
+    def __init__(self):
+        self.blocks, self.commits = {}, {}
+
+    def load_block(self, h):
+        return self.blocks.get(h)
+
+    def load_block_commit(self, h):
+        return self.commits.get(h)
+
+    def load_seen_commit(self, h):
+        return None
+
+
+class _StubStateStore:
+    def __init__(self):
+        self.vals = {}
+
+    def load_validators(self, h):
+        return self.vals.get(h)
+
+
+class _StubBlock:
+    def __init__(self, header):
+        self.header = header
+
+
+@pytest.fixture(scope="module")
+def churn():
+    signers = fx.make_signers(6, seed=7)
+    by_addr = {s.address(): s for s in signers}
+    bs, ss = _StubBlockStore(), _StubStateStore()
+    for h, idxs in CHURN_SETS.items():
+        ss.vals[h] = fx.make_validator_set([signers[i] for i in idxs])
+    from cometbft_tpu.types.block import Header
+
+    for h in range(1, 10):
+        bid = fx.make_block_id(b"churn-%d" % h)
+        hdr = Header(
+            chain_id=CHURN_CHAIN, height=h,
+            validators_hash=ss.vals[h].hash(),
+            next_validators_hash=ss.vals[h + 1].hash(),
+            proposer_address=ss.vals[h].validators[0].address,
+        )
+        bs.blocks[h] = _StubBlock(hdr)
+        bs.commits[h] = fx.make_commit(
+            CHURN_CHAIN, h, 0, bid, ss.vals[h], by_addr
+        )
+    return LightServe(CHURN_CHAIN, bs, ss, backend="cpu")
+
+
+def test_overlap_screen_monotone_under_churn(churn):
+    # from trusted h=1 the screen passes exactly through height 5
+    for m in range(2, 6):
+        assert churn._overlap_ok(1, m), m
+    for m in range(6, 10):
+        assert not churn._overlap_ok(1, m), m
+    # and the chosen pivot reaches the target
+    assert churn._overlap_ok(5, 9)
+
+
+def test_bisection_pivots_minimal_under_churn(churn):
+    plan = churn.plan_bisection(1, 9)
+    assert plan == [5, 9]
+    # minimal: a shorter plan would be the direct hop, which the churn
+    # makes impossible; and every hop in the plan is itself reachable
+    assert not churn._overlap_ok(1, 9)
+    hops = [1] + plan
+    for a, b in zip(hops, hops[1:]):
+        assert b == a + 1 or churn._overlap_ok(a, b)
+    # greedy picks the FARTHEST reachable pivot, not just any pivot
+    assert all(not churn._overlap_ok(1, m) for m in range(6, 9))
+    # no-churn fast path: adjacent target needs no intermediate pivots
+    assert churn.plan_bisection(8, 9) == [9]
+    with pytest.raises(ValueError):
+        churn.plan_bisection(9, 9)
+
+
+def test_bisect_verifies_each_pivot_once(churn):
+    lbs = churn.bisect(1, 9)
+    assert [lb.height for lb in lbs] == [5, 9]
+    assert churn.cache.verify_calls[5] == 1
+    assert churn.cache.verify_calls[9] == 1
+    # a second bisection reuses the cache: no new verifications
+    churn.bisect(1, 9)
+    assert churn.cache.verify_calls[5] == 1
+    assert churn.cache.verify_calls[9] == 1
+
+
+def test_bisection_constant_valset_is_direct(chain):
+    srv = _serve(chain)
+    assert srv.plan_bisection(1, 12) == [12]
+    lbs = srv.bisect(1, 12)
+    assert [lb.height for lb in lbs] == [12]
+
+
+# -- RPC routes ----------------------------------------------------------
+
+
+def test_light_routes_disabled_without_serve():
+    client = LocalClient(Env())
+    for call in (lambda: client.light_status(),
+                 lambda: client.light_mmr_proof(height="3"),
+                 lambda: client.light_bisect(trusted_height="1", height="5")):
+        with pytest.raises(RPCError):
+            call()
+
+
+def test_light_status_and_proof_routes(chain):
+    store, state, ss = chain
+    srv = _serve(chain)
+    client = LocalClient(Env(light_serve=srv))
+    st = client.light_status()
+    assert st["mmr_size"] == 12
+    assert st["base_height"] == "1"
+    r = client.light_mmr_proof(height="8")
+    assert r["height"] == "8" and int(r["leaf_index"]) == 7
+    assert verify_ancestry(
+        bytes.fromhex(r["mmr_root"]), int(r["mmr_size"]),
+        int(r["base_height"]), 8, store.load_block(8).header.hash(),
+        bytes.fromhex(r["proof"]),
+    )
+    assert r["proof_bytes"] == len(r["proof"]) // 2
+    with pytest.raises(RPCError):
+        client.light_mmr_proof(height="99")
+    srv.stop()
+
+
+def test_light_bisect_route(churn):
+    client = LocalClient(Env(light_serve=churn))
+    r = client.light_bisect(trusted_height="1", height="9")
+    assert r["pivot_heights"] == ["5", "9"]
+    assert len(r["pivots"]) == 2
+    assert r["pivots"][1]["signed_header"]["commit"]["height"] == "9"
+    with pytest.raises(RPCError):
+        client.light_bisect(trusted_height="9", height="9")
+
+
+# -- /light_stream HTTP endpoint ----------------------------------------
+
+
+def test_light_stream_http_endpoint(chain):
+    store, state, ss = chain
+    srv = LightServe(CHAIN, store, ss, backend="cpu")
+    for h in range(1, 4):
+        srv.on_commit(store.load_block(h))
+    server = RPCServer(Env(light_serve=srv), host="127.0.0.1", port=0)
+    server.start()
+    host, port = server.addr
+    try:
+        def feeder():
+            time.sleep(0.2)
+            for h in range(4, 7):
+                srv.on_commit(store.load_block(h))
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        url = f"http://{host}:{port}/light_stream?limit=3&timeout_s=10"
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/jsonl")
+            lines = [json.loads(ln) for ln in resp if ln.strip()]
+        t.join()
+        assert [p["height"] for p in lines] == [4, 5, 6]
+        for p in lines:
+            assert _check_payload(p, srv.base_height), p["height"]
+        assert srv.subscriber_count == 0, "stream unsubscribes on close"
+    finally:
+        server.stop()
+        srv.stop()
+
+
+def test_light_stream_http_503_when_disabled():
+    server = RPCServer(Env(), host="127.0.0.1", port=0)
+    server.start()
+    host, port = server.addr
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/light_stream?limit=1", timeout=5)
+        assert ei.value.code == 503
+    finally:
+        server.stop()

@@ -785,6 +785,116 @@ def bench_ingest_sustained_load(clients=32, duration_s=8.0, window=256):
     }
 
 
+def bench_light_stream_fanout(clients=10000, duration_s=10.0, workers=8,
+                              http_streams=4):
+    """Light-client streaming-service workload (ROADMAP item #2):
+    tools/lightload.py boots one serving validator and simulates
+    `clients` concurrent /light_stream subscribers plus a proof/bisect
+    request pool against it.
+
+    Two gate classes:
+
+    - asserted EVERYWHERE (they measure correctness of the serving
+      surface, not host speed): per-height commit verification count
+      == 1 under the whole fan-out (cache amortization), every
+      simulated client served, MMR proof bytes within the O(log n)
+      bound, and every proof received over real HTTP verifying
+      client-side;
+    - machine-gated on >=2 cores (throughput/latency would gate on
+      scheduler interleaving when 10k queues, consensus, and the
+      drainers time-share one core): headers/s, deliveries/s, p99
+      proof latency.
+    """
+    import subprocess
+
+    n_clients = 500 if QUICK else clients
+    dur = 4.0 if QUICK else duration_s
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "lightload.py")
+    p = subprocess.run(
+        [sys.executable, script, "--clients", str(n_clients),
+         "--duration", str(dur), "--workers", str(workers),
+         "--http-streams", str(http_streams)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"lightload rc={p.returncode}\nstderr: {p.stderr[-2000:]}")
+    rec = None
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if rec is None:
+        raise RuntimeError(f"lightload produced no JSON: {p.stdout[-500:]}")
+    print(f"  light fan-out: {rec['clients_served']}/{rec['clients']} "
+          f"clients, {rec['headers_per_sec']} headers/s, "
+          f"{rec['deliveries_per_sec']} deliveries/s, proof p99 "
+          f"{rec['proof_p99_ms']} ms, verify/height "
+          f"{rec['max_verify_calls_per_height']}", file=sys.stderr)
+
+    # --- correctness gates: asserted unconditionally -------------------
+    assert rec["max_verify_calls_per_height"] == 1, (
+        f"cache amortization broken: a height was commit-verified "
+        f"{rec['max_verify_calls_per_height']} times under fan-out"
+    )
+    assert rec["clients_served"] == rec["clients"], (
+        f"only {rec['clients_served']}/{rec['clients']} subscribers "
+        "received payloads"
+    )
+    assert rec["proof_bytes_max"] <= rec["proof_bytes_bound"], (
+        f"MMR proof {rec['proof_bytes_max']} B exceeds the O(log n) "
+        f"bound {rec['proof_bytes_bound']} B at n={rec['mmr_size']}"
+    )
+    assert rec["http_stream_lines"] > 0 and not rec["http_stream_errors"], (
+        f"/light_stream HTTP path failed: {rec['http_stream_errors']}"
+    )
+    assert rec["http_stream_verified"] == rec["http_stream_lines"], (
+        "a streamed proof failed client-side ancestry verification"
+    )
+
+    # --- throughput gates: machine-gated -------------------------------
+    gate = {
+        "verify_calls_per_height": 1,
+        "all_clients_served": True,
+        "proof_bytes_within_log_bound": True,
+        "http_stream_proofs_verified": True,
+        "min_headers_per_sec": 2.0,
+        "min_deliveries_per_sec": float(n_clients),
+        "max_proof_p99_ms": 50.0,
+    }
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        gate["asserted"] = False
+        gate["reason"] = (
+            f"starved host: {cores} core(s) — consensus, 10k subscriber "
+            "queues, drain sweeps, and the request pool time-share the "
+            "core, so throughput thresholds would gate on scheduler "
+            "interleaving; correctness gates above asserted anyway. "
+            "Re-run `python tools/workloads.py --light` on a >=2-core "
+            "host"
+        )
+    else:
+        gate["asserted"] = True
+        assert rec["headers_per_sec"] >= gate["min_headers_per_sec"], (
+            f"served {rec['headers_per_sec']} headers/s < "
+            f"{gate['min_headers_per_sec']}"
+        )
+        assert rec["deliveries_per_sec"] >= gate["min_deliveries_per_sec"], (
+            f"{rec['deliveries_per_sec']} deliveries/s < "
+            f"{gate['min_deliveries_per_sec']}"
+        )
+        assert rec["proof_p99_ms"] <= gate["max_proof_p99_ms"], (
+            f"proof p99 {rec['proof_p99_ms']} ms > "
+            f"{gate['max_proof_p99_ms']} ms"
+        )
+    rec["gate"] = gate
+    return rec
+
+
 def main():
     if "--multichip-child" in sys.argv:
         i = sys.argv.index("--multichip-child")
@@ -805,6 +915,11 @@ def main():
         return
     if "--ingest" in sys.argv:
         rec = bench_ingest_sustained_load()
+        _emit(rec)
+        _merge_workloads([rec])
+        return
+    if "--light" in sys.argv:
+        rec = bench_light_stream_fanout()
         _emit(rec)
         _merge_workloads([rec])
         return
