@@ -197,6 +197,12 @@ class Application(ABC):
         """local_last_commit: ExtendedCommit with the vote extensions the
         app attached at height-1 (None while extensions are disabled) —
         reference PrepareProposalRequest.LocalLastCommit."""
+        # columnar fast path (mempool/txcolumns.py): the default
+        # byte-budget prefix is an offsets bisect sharing the blob —
+        # same txs as the loop below, no per-tx materialization
+        prefix = getattr(txs, "prefix_max_bytes", None)
+        if prefix is not None:
+            return prefix(max_tx_bytes)
         out, total = [], 0
         for tx in txs:
             total += len(tx)

@@ -97,6 +97,11 @@ class P2PConfig:
     max_outbound_peers: int = 10
     send_rate: int = 512_000  # bytes/s (reference 500 KB/s default)
     recv_rate: int = 512_000
+    # data bytes per MConnection packet. 1024 keeps the reference's wire
+    # shape; the receive path is frame-size-agnostic, so peers at
+    # different sizes interoperate (e2e nets raise this — fewer
+    # header/seal round-trips per block part)
+    max_packet_payload_size: int = 1024
     # arm the fault-injection control channel (data/partition.json ->
     # transport-level peer blocking) — test harness only; a production
     # node must not expose a file that silently isolates it
@@ -109,6 +114,8 @@ class P2PConfig:
             raise ValueError("pex_interval_s must be positive")
         if self.seed_mode and not self.pex:
             raise ValueError("seed_mode requires pex")
+        if self.max_packet_payload_size <= 0:
+            raise ValueError("max_packet_payload_size must be positive")
 
     @staticmethod
     def _addr_list(raw: str) -> list[tuple[str, int]]:
@@ -161,6 +168,12 @@ class ConsensusConfig:
     timeout_precommit: float = 1.0
     timeout_precommit_delta: float = 0.5
     timeout_commit: float = 1.0
+    # speculative proposal assembly (ISSUE 11): when this node is the
+    # next height's proposer, reap + build the proposal block in the
+    # background during the previous height's commit gap; enter_propose
+    # consumes it only if (height, last-commit, state, mempool) still
+    # match, else discards bit-safely and rebuilds cold
+    speculative_propose: bool = True
 
     def validate(self) -> None:
         for name in ("timeout_propose", "timeout_prevote", "timeout_precommit",

@@ -63,10 +63,15 @@ class InProcessNode:
             timeouts=timeouts,
             tx_source=tx_source or self._reap_txs,
             name=f"node{idx}",
+            # same wiring as node.py: speculative round-0 proposals with
+            # the mempool version as the staleness probe (ISSUE 11)
+            speculative=True,
+            mempool_version=lambda: self.mempool.version,
         )
 
     def _reap_txs(self):
-        return self.mempool.reap_max_bytes_max_gas(max_bytes=1 << 20)
+        # columnar reap, as in production (node/node.py tx_source)
+        return self.mempool.reap_columns(max_bytes=1 << 20)
 
 
 class InProcessNetwork:

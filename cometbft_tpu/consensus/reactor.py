@@ -492,6 +492,11 @@ class ConsensusReactor(Reactor):
         # this bound is about liveness and memory).
         self._vb_height = 0
         self._vb_candidates: dict[bytes, tuple[PartSetHeader, dict[int, Part]]] = {}
+        # encoded BlockPartMessage frames keyed (height, round, index):
+        # gossiping P parts to N peers otherwise re-encodes the same
+        # merkle-proved part N times (catchup frames carry the PEER's
+        # round, hence round in the key); bounded FIFO
+        self._part_frame_cache: dict[tuple[int, int, int], bytes] = {}
         cs.broadcast = self.broadcast_msg
         cs.on_new_step = self._on_new_step
         cs.on_has_vote = self._on_has_vote
@@ -568,6 +573,9 @@ class ConsensusReactor(Reactor):
             with self._lock:
                 self._round_parts = ps
                 self._round_parts_hr = (msg.height, msg.round)
+                # frames cached for an earlier (h, r) generation must
+                # not alias the new round's parts
+                self._part_frame_cache.clear()
         elif isinstance(msg, ProposalMessage):
             # proposal itself is picked up from cs.proposal by gossip;
             # nothing to store (cs sets cs.proposal before broadcasting)
@@ -654,6 +662,7 @@ class ConsensusReactor(Reactor):
         # serve the parts onward to peers that still miss them
         self._round_parts = PartSet(parts, hdr)
         self._round_parts_hr = (height, round_)
+        self._part_frame_cache.clear()
         return data
 
     def _begin_assembly(self, proposal: Proposal, peer_id: str) -> None:
@@ -817,6 +826,24 @@ class ConsensusReactor(Reactor):
                 ),
             )
 
+    PART_FRAME_CACHE_SIZE = 256
+
+    def _part_frame(self, h: int, r: int, part) -> bytes:
+        """Encoded BlockPartMessage frame, cached per (height, round,
+        index) so N peer gossip routines share one encode per part."""
+        key = (h, r, part.index)
+        with self._lock:
+            frame = self._part_frame_cache.get(key)
+        if frame is None:
+            frame = encode_consensus_msg(BlockPartMessage(h, r, part))
+            with self._lock:
+                frame = self._part_frame_cache.setdefault(key, frame)
+                while len(self._part_frame_cache) > self.PART_FRAME_CACHE_SIZE:
+                    self._part_frame_cache.pop(
+                        next(iter(self._part_frame_cache))
+                    )
+        return frame
+
     def _gossip_data(self, ps: PeerState) -> bool:
         cs = self.cs
         h, r, step, prop_seen, peer_parts = ps.snapshot()
@@ -877,10 +904,7 @@ class ConsensusReactor(Reactor):
                     ),
                 )
             if part is not None:
-                ps.peer.send(
-                    DATA_CHANNEL,
-                    encode_consensus_msg(BlockPartMessage(h, r, part)),
-                )
+                ps.peer.send(DATA_CHANNEL, self._part_frame(h, r, part))
             return True
         if h != cs.height:
             return False
@@ -901,10 +925,7 @@ class ConsensusReactor(Reactor):
             for part in parts.parts:
                 if part.index not in peer_parts:
                     ps.peer.send(
-                        DATA_CHANNEL,
-                        encode_consensus_msg(
-                            BlockPartMessage(hr[0], hr[1], part)
-                        ),
+                        DATA_CHANNEL, self._part_frame(hr[0], hr[1], part)
                     )
                     ps.mark_part(hr[0], hr[1], part.index)
                     return True

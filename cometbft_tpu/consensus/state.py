@@ -47,6 +47,7 @@ from ..types import (
     Vote,
 )
 from ..types.block import block_id_for
+from ..types.evidence import evidence_list_hash
 from ..types.vote import SignedMsgType
 from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
 from .height_vote_set import HeightVoteSet
@@ -98,6 +99,21 @@ class VoteMessage:
     vote: Vote
 
 
+@dataclass
+class _SpeculativeProposal:
+    """A proposal block assembled ahead of enter_propose, with everything
+    the assembly depended on so the consume seam can prove nothing moved.
+    `state` is identity-compared: a different object means ApplyBlock ran
+    again (app hash, valset, results all derive from it)."""
+
+    height: int
+    state: object
+    last_commit_hash: bytes
+    mempool_version: int
+    block: Block
+    block_id: BlockID
+
+
 class ConsensusState:
     """One validator's consensus engine over an in-process transport."""
 
@@ -115,6 +131,8 @@ class ConsensusState:
         name: str = "",
         now_ns=None,
         ticker_factory=None,
+        speculative: bool = False,
+        mempool_version=None,
     ):
         self.chain_id = chain_id
         self.sm_state = sm_state
@@ -127,6 +145,15 @@ class ConsensusState:
         self.tx_source = tx_source or (lambda: [])
         self.name = name or (privval.address().hex()[:8] if privval else "observer")
         self.now_ns = now_ns or time.time_ns
+        # speculative proposal assembly (ISSUE 11): when enabled and this
+        # node proposes the next height, reap + block assembly run in a
+        # background worker during the commit gap; mempool_version is the
+        # staleness probe the consume seam checks (CListMempool.version)
+        self.speculative = speculative
+        self.mempool_version = mempool_version or (lambda: 0)
+        self._spec_lock = threading.Lock()
+        self._spec_thread: threading.Thread | None = None
+        self._spec: _SpeculativeProposal | None = None
 
         self._log = logger("consensus").with_fields(node=self.name)
         self._last_commit_mono: float | None = None
@@ -583,12 +610,20 @@ class ConsensusState:
             block, bid = self.valid_block, self.valid_block_id
         else:
             last_commit = self._last_commit_for_proposal()
-            block = self.executor.create_proposal_block(
-                h, self.sm_state, last_commit, proposer.address,
-                self.tx_source(),
-                block_time=self._proposal_block_time(),
-            )
-            bid = block_id_for(block)
+            spec = self._take_speculative(h, r, last_commit)
+            if spec is not None:
+                block, bid = spec.block, spec.block_id
+            else:
+                block = self.executor.create_proposal_block(
+                    h, self.sm_state, last_commit, proposer.address,
+                    self.tx_source(),
+                    block_time=self._proposal_block_time(),
+                )
+                # encode exactly once: the memo feeds block_id_for's
+                # part-set, the BlockBytesMessage broadcast below, and
+                # _finalize_commit's size gauge
+                block.__dict__["_enc_memo"] = block.encode()
+                bid = block_id_for(block)
         if _txlife.enabled:
             _txlife.stage_block(self._lifecycle_pairs(block, bid), "reap",
                                 height=h)
@@ -597,7 +632,9 @@ class ConsensusState:
             timestamp=Timestamp.from_unix_ns(self.now_ns()),
         )
         self.privval.sign_proposal(self.chain_id, proposal)
-        bb = BlockBytesMessage(h, r, block.encode())
+        bb = BlockBytesMessage(
+            h, r, block.__dict__.get("_enc_memo") or block.encode()
+        )
         if not self._replay_mode:
             self.broadcast(ProposalMessage(proposal))
             self.broadcast(bb)
@@ -631,6 +668,114 @@ class ConsensusState:
             return Commit()
         assert self.last_commit is not None, "no last commit at height > initial"
         return self.last_commit.make_commit()
+
+    # ------------------------------------------------------------------
+    # speculative proposal assembly (ISSUE 11)
+    # ------------------------------------------------------------------
+    def _maybe_speculate(self) -> None:
+        """Kick off background proposal assembly for the height just
+        entered, overlapping the reap + create_proposal_block + encode
+        work with the NEW_HEIGHT commit gap (where the PR-9 observatory
+        attributed 42.9% of e2e p50 as proposal_wait). Runs only when
+        this node is the round-0 proposer; enter_propose consumes the
+        result through _take_speculative, which re-checks everything the
+        assembly depended on and discards on any mismatch — the cold
+        path is always correct, speculation only ever saves time."""
+        with self._spec_lock:
+            if self._spec is not None:
+                # previous height's block was never consumed (e.g. a
+                # valid_block lock superseded it)
+                self._spec = None
+                consensus_metrics().speculation_total.inc(1.0, "discard")
+        if (
+            not self.speculative
+            or self._replay_mode
+            or self.privval is None
+            or self.height == self.sm_state.initial_height
+        ):
+            return
+        if self.validators.get_proposer().address != self.privval.address():
+            return
+        h = self.height
+        state = self.sm_state
+        last_commit = self.last_commit.make_commit()
+        mv = self.mempool_version()
+        proposer_addr = self.privval.address()
+
+        def work():
+            t0 = time.perf_counter()
+            try:
+                # block_time is omitted on purpose: non-initial heights
+                # derive the header time from median_time(last_commit),
+                # which is frozen in the snapshot above — so the result
+                # is bit-exact with the cold path
+                block = self.executor.create_proposal_block(
+                    h, state, last_commit, proposer_addr, self.tx_source()
+                )
+                enc = block.encode()
+                block.__dict__["_enc_memo"] = enc
+                bid = block_id_for(block)
+            except Exception:  # noqa: BLE001 — speculation must never hurt
+                return
+            with self._spec_lock:
+                if self._spec_thread is not t:
+                    # superseded by a newer height's worker: drop
+                    consensus_metrics().speculation_total.inc(
+                        1.0, "discard")
+                    return
+                self._spec = _SpeculativeProposal(
+                    height=h, state=state,
+                    last_commit_hash=last_commit.hash(),
+                    mempool_version=mv, block=block, block_id=bid,
+                )
+            if trace.enabled:
+                trace.emit(
+                    "consensus.propose_speculative", "span",
+                    dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                    height=h, txs=len(block.data.txs), bytes=len(enc),
+                )
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"cs-spec-{self.name}")
+        self._spec_thread = t
+        t.start()
+
+    def _take_speculative(self, h: int, r: int, last_commit: Commit):
+        """The correctness seam: hand back the speculative block only if
+        every input it was assembled from is still what enter_propose
+        would use — otherwise discard. Joining an in-flight worker is
+        never slower than redoing the same assembly on this thread."""
+        t = self._spec_thread
+        if t is None:
+            return None
+        t.join()
+        self._spec_thread = None
+        with self._spec_lock:
+            spec, self._spec = self._spec, None
+        if spec is None:
+            consensus_metrics().speculation_total.inc(1.0, "discard")
+            return None
+        ok = (
+            r == 0
+            and spec.height == h
+            and spec.state is self.sm_state
+            and spec.mempool_version == self.mempool_version()
+            and spec.last_commit_hash == last_commit.hash()
+            and spec.block.header.evidence_hash == self._evidence_hash_now()
+        )
+        consensus_metrics().speculation_total.inc(
+            1.0, "hit" if ok else "discard")
+        return spec if ok else None
+
+    def _evidence_hash_now(self) -> bytes:
+        """Hash of the evidence create_proposal_block would include NOW
+        (same pending_evidence budget it applies)."""
+        pool = getattr(self.executor, "evidence_pool", None)
+        if pool is None:
+            return evidence_list_hash([])
+        params = self.sm_state.consensus_params
+        cap = min(params.evidence.max_bytes, params.block.max_bytes // 10)
+        return evidence_list_hash(pool.pending_evidence(cap))
 
     def _proposal_complete(self) -> bool:
         return (
@@ -798,7 +943,9 @@ class ConsensusState:
         m.validators.set(len(self.validators))
         m.num_txs.set(len(block.data.txs))
         m.total_txs.inc(len(block.data.txs))
-        m.block_size_bytes.set(len(block.encode()))
+        m.block_size_bytes.set(
+            len(block.__dict__.get("_enc_memo") or block.encode())
+        )
         m.missing_validators.set(
             sum(1 for cs in seen_commit.signatures if cs.is_absent())
         )
@@ -835,6 +982,7 @@ class ConsensusState:
             TimeoutInfo(self.timeouts.commit, self.height, 0,
                         int(RoundStep.NEW_HEIGHT))
         )
+        self._maybe_speculate()
 
     # ==================================================================
     # voting

@@ -209,6 +209,10 @@ class Runner:
             # fast PEX cadence so a seed-only bootstrap converges well
             # inside the test budget (discovery needs a few round trips)
             cfg.p2p.pex_interval_s = 0.5
+            # localhost nets aren't MTU-bound: bigger packets mean fewer
+            # header+seal round trips per block part (ISSUE 11); mixed
+            # sizes interop since receivers are frame-size-agnostic
+            cfg.p2p.max_packet_payload_size = 8192
             # record ABCI call sequences for the post-run conformance
             # check (reference test/e2e/pkg/grammar/checker.go)
             cfg.base.abci_call_log = True

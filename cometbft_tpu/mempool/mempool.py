@@ -32,6 +32,7 @@ import threading
 
 from ..utils.metrics import mempool_metrics
 from ..utils import txlife as _txlife
+from .txcolumns import TxColumns
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 
@@ -116,6 +117,12 @@ class CListMempool:
         self._txs: OrderedDict[bytes, _MempoolTx] = OrderedDict()
         self._lock = threading.RLock()  # the consensus Lock/Unlock seam
         self._bytes = 0  # running byte total (total_bytes was an O(N) scan)
+        # monotonic pool-content version: bumped whenever the set of
+        # reapable txs changes (insert/update/flush). The speculative
+        # proposal seam compares versions across the speculation window
+        # — a bump means the reap it ran is stale and the block must be
+        # discarded (ISSUE 11).
+        self.version = 0
         self.height = 0
         # gossip seams (p2p reactor subscribes): on_new_txs gets the
         # whole admitted window in one call; on_new_tx is the legacy
@@ -198,6 +205,7 @@ class CListMempool:
                 self._txs[key] = _MempoolTx(tx, self.height, gas_wanted)
                 self._bytes += len(tx)
                 errs.append(None)
+                self.version += 1
             m.size.set(len(self._txs))
             m.tx_bytes.set(self._bytes)
         return errs
@@ -342,6 +350,28 @@ class CListMempool:
                 total_g += t.gas_wanted
         return out
 
+    def reap_columns(self, max_bytes: int = -1, max_gas: int = -1
+                     ) -> TxColumns:
+        """Columnar reap: the same FIFO budget walk as
+        reap_max_bytes_max_gas, but the result is ONE contiguous blob +
+        offsets built under a single lock acquisition — the proposal
+        path carries it through prepare_proposal, Data hash/encode, and
+        block parts without re-materializing per-tx byte strings."""
+        chunks: list[bytes] = []
+        offsets = [0]
+        total_b, total_g = 0, 0
+        with self._lock:
+            for t in self._txs.values():
+                if max_bytes >= 0 and total_b + len(t.tx) > max_bytes:
+                    break
+                if max_gas >= 0 and total_g + t.gas_wanted > max_gas:
+                    break
+                chunks.append(t.tx)
+                total_b += len(t.tx)
+                total_g += t.gas_wanted
+                offsets.append(total_b)
+        return TxColumns(b"".join(chunks), offsets)
+
     def update(self, height: int, committed_txs: list[bytes],
                results=None) -> None:
         """Post-commit bookkeeping + recheck (reference Update :~560).
@@ -351,6 +381,7 @@ class CListMempool:
         consensus-held lock window costs ceil(N/window) app calls
         instead of N."""
         self.height = height
+        self.version += 1
         for i, tx in enumerate(committed_txs):
             key = TxKey(tx)
             code = results[i].code if results else 0
@@ -384,6 +415,7 @@ class CListMempool:
             self._txs.clear()
             self.cache.reset()
             self._bytes = 0
+            self.version += 1
             m = mempool_metrics()
             m.size.set(0)
             m.tx_bytes.set(0)
@@ -394,6 +426,8 @@ class CListMempool:
 
 class NopMempool:
     """Disabled mempool (reference mempool/nop_mempool.go:111)."""
+
+    version = 0
 
     def lock(self):
         pass
@@ -409,6 +443,9 @@ class NopMempool:
 
     def reap_max_bytes_max_gas(self, max_bytes: int = -1, max_gas: int = -1):
         return []
+
+    def reap_columns(self, max_bytes: int = -1, max_gas: int = -1):
+        return TxColumns(b"", [0])
 
     def update(self, height, committed_txs, results=None) -> None:
         pass

@@ -255,10 +255,13 @@ class Node:
             privval=self.priv_validator,
             wal=self.wal,
             timeouts=config.consensus.timeouts(),
-            tx_source=lambda: self.mempool.reap_max_bytes_max_gas(
-                max_bytes=1 << 20
-            ),
+            # columnar carry-through (ISSUE 11): reap hands consensus a
+            # TxColumns batch — one contiguous blob + offsets — that
+            # rides unchanged into Data.hash/encode and prepare_proposal
+            tx_source=lambda: self.mempool.reap_columns(max_bytes=1 << 20),
             name=config.base.moniker,
+            speculative=config.consensus.speculative_propose,
+            mempool_version=lambda: self.mempool.version,
         )
 
         # --- p2p -------------------------------------------------------
@@ -279,6 +282,7 @@ class Node:
             self.transport,
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
+            max_packet_payload_size=config.p2p.max_packet_payload_size,
         )
         self.consensus_reactor = ConsensusReactor(self.consensus)
         self.consensus_reactor.set_switch(self.switch)

@@ -145,6 +145,10 @@ class SecretConnection:
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
         self._recv_buf = b""
+        # reusable plaintext-frame scratch for the vectored send path:
+        # one per connection, only ever touched under _send_lock
+        self._frame_scratch = bytearray(FRAME_SIZE)
+        self._zero_pad = bytes(DATA_MAX_SIZE)
 
         eph_priv, eph_pub = _x25519_keypair()
         sock.sendall(eph_pub)
@@ -221,10 +225,49 @@ class SecretConnection:
             buf += chunk
         return buf
 
+    def write_views(self, *bufs) -> None:
+        """Vectored write_msg: seal the logical concatenation of `bufs`
+        as ONE length-prefixed message without materializing it.
+        Wire-identical to ``write_msg(b"".join(bufs))`` — callers hand
+        down memoryview slices (the MConnection zero-copy send path) and
+        the only copy before encryption is the slice-assign into the
+        per-connection frame scratch."""
+        views = [memoryview(b) for b in bufs]
+        total = sum(len(v) for v in views)
+        views.insert(0, memoryview(struct.pack("<I", total)))
+        with self._send_lock:
+            scratch = self._frame_scratch
+            vi, pos = 0, 0
+            remaining = DATA_LEN_SIZE + total  # length prefix + payload
+            while remaining > 0:
+                take = min(DATA_MAX_SIZE, remaining)
+                struct.pack_into("<I", scratch, 0, take)
+                off = DATA_LEN_SIZE
+                need = take
+                while need:
+                    v = views[vi]
+                    k = min(len(v) - pos, need)
+                    if k:
+                        scratch[off:off + k] = v[pos:pos + k]
+                        off += k
+                        pos += k
+                        need -= k
+                    if pos == len(v):
+                        vi += 1
+                        pos = 0
+                if take < DATA_MAX_SIZE:
+                    scratch[off:FRAME_SIZE] = \
+                        self._zero_pad[:FRAME_SIZE - off]
+                sealed = self._send_aead.encrypt(
+                    self._send_nonce.next(), bytes(scratch), None
+                )
+                self._sock.sendall(sealed)
+                remaining -= take
+
     # message helpers for the handshake/MConnection layers: each message is
     # sent as its own frame sequence prefixed with a 4-byte length
-    def write_msg(self, data: bytes) -> None:
-        self.write(struct.pack("<I", len(data)) + data)
+    def write_msg(self, data) -> None:
+        self.write_views(data)
 
     def read_msg(self) -> bytes:
         (ln,) = struct.unpack("<I", self.read_exact(4))

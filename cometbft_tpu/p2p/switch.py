@@ -73,12 +73,17 @@ class DuplicatePeerError(ValueError):
 
 class Switch:
     def __init__(self, transport: Transport, send_rate: int | None = None,
-                 recv_rate: int | None = None):
-        from .conn import DEFAULT_RECV_RATE, DEFAULT_SEND_RATE
+                 recv_rate: int | None = None,
+                 max_packet_payload_size: int | None = None):
+        from .conn import (DEFAULT_RECV_RATE, DEFAULT_SEND_RATE,
+                           MAX_PACKET_PAYLOAD)
 
         self.transport = transport
         self.send_rate = DEFAULT_SEND_RATE if send_rate is None else send_rate
         self.recv_rate = DEFAULT_RECV_RATE if recv_rate is None else recv_rate
+        self.max_packet_payload_size = (
+            MAX_PACKET_PAYLOAD if max_packet_payload_size is None
+            else max_packet_payload_size)
         self._reactors: list[Reactor] = []
         self._chan_owner: dict[int, Reactor] = {}
         self._descs: list[ChannelDescriptor] = []
@@ -298,9 +303,11 @@ class Switch:
         def on_error(exc) -> None:
             self.stop_peer_for_error(holder["peer"], exc)
 
-        mconn = MConnection(sconn, self._descs, on_receive, on_error,
-                            send_rate=self.send_rate,
-                            recv_rate=self.recv_rate)
+        mconn = MConnection(
+            sconn, self._descs, on_receive, on_error,
+            send_rate=self.send_rate,
+            recv_rate=self.recv_rate,
+            max_packet_payload_size=self.max_packet_payload_size)
         peer = Peer(info, mconn, outbound, tracer=self.msg_tracer)
         holder["peer"] = peer
         if peer.id in self._blocked:

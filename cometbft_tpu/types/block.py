@@ -615,9 +615,19 @@ class Data:
     txs: list[bytes] = field(default_factory=list)
 
     def hash(self) -> bytes:
+        # columnar fast path (mempool/txcolumns.py): the batch memoizes
+        # its per-tx hash column — bit-identical leaves, merkle unchanged
+        hashes = getattr(self.txs, "tx_hashes", None)
+        if hashes is not None:
+            return merkle.hash_from_byte_slices(hashes())
         return merkle.hash_from_byte_slices([tx_hash(t) for t in self.txs])
 
     def encode(self) -> bytes:
+        # columnar fast path: the batch memoizes the exact repeated
+        # f_bytes(1, tx, emit_empty=True) payload this loop produces
+        enc = getattr(self.txs, "encode_data", None)
+        if enc is not None:
+            return enc()
         out = b""
         for t in self.txs:
             out += pb.f_bytes(1, t, emit_empty=True)

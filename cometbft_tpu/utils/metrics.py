@@ -307,6 +307,14 @@ class ConsensusMetrics:
             "consensus", "tx_commit_seconds",
             "Per-tx end-to-end arrival->commit latency; sampled txs only",
             buckets=TX_STAGE_BUCKETS)
+        # speculative proposal assembly (ISSUE 11): hit = the block built
+        # during the previous height's commit gap was consumed bit-exact
+        # by enter_propose; discard = a round bump, valid_block lock,
+        # late precommit, or mempool update invalidated it
+        self.speculation_total = reg.counter(
+            "consensus", "speculation_total",
+            "Speculative proposal assemblies by outcome",
+            labels=("outcome",))
 
 
 class MempoolMetrics:
@@ -370,6 +378,14 @@ class P2PMetrics:
             "p2p", "broadcast_queue_wait_seconds",
             "Enqueue->send wait of frames in the async broadcast queue",
             buckets=TX_STAGE_BUCKETS)
+        # per-channel MConnection send backlog (ISSUE 11): messages
+        # queued or mid-flight on the channel, summed across peers —
+        # the instrument that shows where the zero-copy send path backs
+        # up under sustained block-part fan-out
+        self.send_queue_depth = reg.gauge(
+            "p2p", "send_queue_depth",
+            "Messages queued on an MConnection send channel",
+            labels=("chan",))
 
 
 class StateMetrics:

@@ -263,6 +263,7 @@ SPAN_REGISTRY = {
     "node.boot": "node identity: moniker + full node id, once per process start",
     "consensus.step": "span closing the consensus step being left (height/round/dur_ms/next)",
     "consensus.finalize_commit": "block decided at height/round, with tx count",
+    "consensus.propose_speculative": "one speculative proposal assembly overlapping the previous height's commit gap (height/txs/bytes)",
     "state.apply_block": "ApplyBlock with validate/finalize/commit/save stage breakdown",
     "blocksync.block": "one fast-synced block: fetch→verify→apply breakdown",
     "crypto.batch_verify": "one batch-verify dispatch: path, n, modeled host/wire/device terms",
@@ -272,6 +273,7 @@ SPAN_REGISTRY = {
     "mempool.admit_window": "one micro-batched admission window: n/dup/sig_fail/app_fail/admitted + stage ms",
     "tx.lifecycle": "one stage crossing of a sampled tx (tx/stage/mono; utils/txlife.py — hash-prefix sampled, correlated across nodes by tx)",
     "p2p.send": "consensus wire message handed to a peer (msg/height/round/peer)",
+    "p2p.zero_copy_send": "one multiplexed message fully packetized via memoryview slicing (chan/bytes/packets)",
     "p2p.recv": "consensus wire message received from a peer (msg/height/round/peer)",
     "light.mmr_append": "one committed header folded into the MMR accumulator (height/leaf/size/dur_ms)",
     "light.serve_proof": "one MMR ancestry proof generated for a light client (height/size/bytes)",

@@ -456,3 +456,129 @@ def test_pex_seed_crawler_serves_and_hangs_up(tmp_path):
         assert not sw_s.peers(), "crawl connections were not hung up"
     finally:
         sw_s.stop(); sw_a.stop(); sw_b.stop()
+
+
+# --------------------------------------------- zero-copy framing (ISSUE 11)
+def test_write_views_wire_equals_write_msg():
+    """write_views(a, b, c) must be byte-identical on the wire to
+    write_msg(a + b + c) — including empty views, frame-boundary
+    straddles, and the empty-message single-frame case."""
+    cases = [
+        (b"abc", b"defg", b""),
+        (b"",),
+        (b"", b"", b""),
+        (b"x" * 1020, b"y" * 8),            # straddles the first frame
+        (b"h" * 4, b"z" * 3000, b"tail"),   # multi-frame
+        (bytes(range(256)) * 17,),
+    ]
+    for bufs in cases:
+        sca, scb, _, _ = _sc_pair()
+        joined = b"".join(bufs)
+        sca.write_views(*[memoryview(b) for b in bufs])
+        assert scb.read_msg() == joined, f"views path broke for {bufs!r}"
+        scb.write_msg(joined)
+        assert sca.read_msg() == joined
+        sca.close(); scb.close()
+
+
+def test_mconnection_mixed_packet_sizes_interop():
+    """Peers running different max_packet_payload_size must interop:
+    the receive path is frame-size-agnostic (one read_msg = one packet)."""
+    sca, scb, _, _ = _sc_pair()
+    got_a, got_b = [], []
+    done_a, done_b = threading.Event(), threading.Event()
+    descs = [ChannelDescriptor(0x40)]
+    big = bytes(range(256)) * 120  # 30720 B, multi-packet on both sides
+    ma = MConnection(sca, descs,
+                     lambda c, m: (got_a.append(m), done_a.set()),
+                     max_packet_payload_size=8192)
+    mb = MConnection(scb, descs,
+                     lambda c, m: (got_b.append(m), done_b.set()),
+                     max_packet_payload_size=1024)
+    ma.start(); mb.start()
+    try:
+        assert ma.send(0x40, big)       # 8 KiB packets -> 1 KiB receiver
+        assert done_b.wait(5)
+        assert got_b == [big]
+        assert mb.send(0x40, big[::-1])  # 1 KiB packets -> 8 KiB receiver
+        assert done_a.wait(5)
+        assert got_a == [big[::-1]]
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_mconnection_per_channel_payload_override():
+    sca, scb, _, _ = _sc_pair()
+    got = []
+    done = threading.Event()
+    descs = [ChannelDescriptor(0x41, packet_payload_size=4096)]
+    ma = MConnection(sca, descs, lambda c, m: None)
+    mb = MConnection(scb, descs,
+                     lambda c, m: (got.append(m), done.set()))
+    assert ma._channels[0x41].payload_cap == 4096
+    msg = b"p" * 10_000
+    ma.start(); mb.start()
+    try:
+        assert ma.send(0x41, msg)
+        assert done.wait(5)
+        assert got == [msg]
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_mconnection_large_message_reassembly_reuses_buffer():
+    """A message far larger than one packet reassembles correctly into
+    the persistent per-channel buffer, twice in a row (buffer reuse)."""
+    sca, scb, _, _ = _sc_pair()
+    got = []
+    done = threading.Event()
+
+    def on_recv(c, m):
+        got.append(m)
+        if len(got) == 2:
+            done.set()
+
+    descs = [ChannelDescriptor(0x42)]
+    ma = MConnection(sca, descs, lambda c, m: None)
+    mb = MConnection(scb, descs, on_recv)
+    m1 = bytes(range(256)) * 1200   # ~300 KiB
+    m2 = m1[::-1][:100_000]
+    ma.start(); mb.start()
+    try:
+        assert ma.send(0x42, m1)
+        assert ma.send(0x42, m2)
+        assert done.wait(10)
+        assert got == [m1, m2]
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_mconnection_recv_capacity_enforced_single_packet():
+    """The single-packet fast path must still enforce the channel's
+    recv_message_capacity."""
+    sca, scb, _, _ = _sc_pair()
+    errs = []
+    done = threading.Event()
+    descs_small = [ChannelDescriptor(0x43, recv_message_capacity=64)]
+    descs_big = [ChannelDescriptor(0x43)]
+    ma = MConnection(sca, descs_big, lambda c, m: None,
+                     max_packet_payload_size=512)
+    mb = MConnection(scb, descs_small, lambda c, m: None,
+                     on_error=lambda e: (errs.append(e), done.set()))
+    ma.start(); mb.start()
+    try:
+        assert ma.send(0x43, b"o" * 400)  # one 400 B packet > 64 B cap
+        assert done.wait(5), "oversized single-packet message not rejected"
+        assert any("capacity" in str(e) for e in errs)
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_packet_payload_size_validation():
+    from cometbft_tpu.config import P2PConfig
+
+    assert P2PConfig().max_packet_payload_size == 1024
+    with pytest.raises(ValueError):
+        P2PConfig(max_packet_payload_size=0).validate()
+    with pytest.raises(ValueError):
+        MConnection(None, [], lambda c, m: None, max_packet_payload_size=0)

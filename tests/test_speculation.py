@@ -1,0 +1,246 @@
+"""Speculative proposal assembly (ISSUE 11): the background worker's
+block must be BIT-EXACT with the cold path, and the consume seam must
+discard it on round bumps, mempool movement, or any other staleness.
+All tests here are unconditional — correctness does not get a
+machine-gate."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.consensus.net import InProcessNetwork
+from cometbft_tpu.consensus.state import RoundStep, TimeoutConfig
+from cometbft_tpu.types.block import block_id_for
+from cometbft_tpu.utils.metrics import consensus_metrics
+
+# timeouts long enough that nothing fires while tests drive the state
+# machine synchronously (the pump below replaces the receive thread)
+SLOW = TimeoutConfig(propose=600, propose_delta=0, prevote=600,
+                     prevote_delta=0, precommit=600, precommit_delta=0,
+                     commit=600)
+
+
+def _pump(net, rounds: int = 200):
+    """Drain every node's inbox synchronously (no receive threads)."""
+    for _ in range(rounds):
+        moved = False
+        for node in net.nodes:
+            while not node.cs.inbox.empty():
+                item = node.cs.inbox.get()
+                if item is not None:
+                    node.cs._process_inner(item)
+                    moved = True
+        if not moved:
+            return
+
+
+def _drive_to_height_2(net):
+    """Synchronously commit height 1 on every node."""
+    for node in net.nodes:
+        node.cs.enter_new_round(1, 0)
+    _pump(net)
+    assert all(n.cs.height == 2 for n in net.nodes), [
+        (n.cs.height, n.cs.step) for n in net.nodes
+    ]
+    assert all(n.cs.step == RoundStep.NEW_HEIGHT for n in net.nodes)
+
+
+def _spec_counts():
+    vals = consensus_metrics().speculation_total.values()
+    return vals.get(("hit",), 0.0), vals.get(("discard",), 0.0)
+
+
+def _fresh_spec(cs):
+    """Re-kick the worker and hand back the stashed result (joined)."""
+    cs._maybe_speculate()
+    t = cs._spec_thread
+    assert t is not None, "speculation did not kick off"
+    t.join(10)
+    with cs._spec_lock:
+        return cs._spec
+
+
+def _stop(net):
+    for node in net.nodes:
+        node.cs.ticker.stop()
+        node.wal.flush()
+
+
+def test_speculative_block_bit_exact_with_cold_path(tmp_path):
+    net = InProcessNetwork(1, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        cs = net.nodes[0].cs
+        # mempool moved after the auto-kicked speculation: re-kick so the
+        # worker sees the txs (the stale result is discarded internally)
+        net.nodes[0].mempool.check_tx(b"spec-k1=v1")
+        net.nodes[0].mempool.check_tx(b"spec-k2=v2")
+        spec = _fresh_spec(cs)
+        assert spec is not None and spec.height == 2
+
+        # the cold path, run independently with the same inputs
+        last_commit = cs._last_commit_for_proposal()
+        cold = cs.executor.create_proposal_block(
+            2, cs.sm_state, last_commit,
+            cs.validators.get_proposer().address, cs.tx_source(),
+        )
+        assert spec.block.encode() == cold.encode()  # bit-exact wire bytes
+        assert spec.block.hash() == cold.hash()
+        assert spec.block_id == block_id_for(cold)
+        assert b"spec-k1=v1" in list(spec.block.data.txs)
+
+        # and the seam hands it out: every staleness probe matches
+        hit0, _ = _spec_counts()
+        taken = cs._take_speculative(2, 0, last_commit)
+        assert taken is spec
+        hit1, _ = _spec_counts()
+        assert hit1 == hit0 + 1
+    finally:
+        _stop(net)
+
+
+def test_full_height_commits_speculative_block(tmp_path):
+    """Drive height 2 end-to-end through enter_propose: the consumed
+    speculative block is what gets committed."""
+    net = InProcessNetwork(1, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        cs = net.nodes[0].cs
+        net.nodes[0].mempool.check_tx(b"committed-via-spec=1")
+        spec = _fresh_spec(cs)
+        assert spec is not None
+        expect_bid = spec.block_id
+        hit0, _ = _spec_counts()
+        cs.enter_new_round(2, 0)
+        _pump(net)
+        assert cs.height == 3
+        assert cs.decided[2] == expect_bid
+        blk = net.nodes[0].block_store.load_block(2)
+        assert b"committed-via-spec=1" in list(blk.data.txs)
+        hit1, _ = _spec_counts()
+        assert hit1 == hit0 + 1
+    finally:
+        _stop(net)
+
+
+def test_discard_on_round_bump(tmp_path):
+    net = InProcessNetwork(1, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        cs = net.nodes[0].cs
+        spec = _fresh_spec(cs)
+        assert spec is not None
+        _, d0 = _spec_counts()
+        last_commit = cs._last_commit_for_proposal()
+        assert cs._take_speculative(2, 1, last_commit) is None  # r != 0
+        _, d1 = _spec_counts()
+        assert d1 == d0 + 1
+        with cs._spec_lock:
+            assert cs._spec is None  # consumed, not kept around
+    finally:
+        _stop(net)
+
+
+def test_discard_on_mempool_update(tmp_path):
+    net = InProcessNetwork(1, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        cs = net.nodes[0].cs
+        spec = _fresh_spec(cs)
+        assert spec is not None
+        # a tx lands AFTER the worker reaped: version probe must fail
+        net.nodes[0].mempool.check_tx(b"late-arrival=1")
+        _, d0 = _spec_counts()
+        assert cs._take_speculative(
+            2, 0, cs._last_commit_for_proposal()) is None
+        _, d1 = _spec_counts()
+        assert d1 == d0 + 1
+        # the cold rebuild after the discard includes the late tx
+        cs.enter_new_round(2, 0)
+        _pump(net)
+        blk = net.nodes[0].block_store.load_block(2)
+        assert b"late-arrival=1" in list(blk.data.txs)
+    finally:
+        _stop(net)
+
+
+def test_valid_block_lock_bypasses_speculation(tmp_path):
+    """When a POL valid_block is locked in, enter_propose must propose
+    IT — the speculative block stays unconsumed and is discarded at the
+    next height's kickoff."""
+    net = InProcessNetwork(1, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        cs = net.nodes[0].cs
+        spec = _fresh_spec(cs)
+        assert spec is not None
+        # lock a DIFFERENT block as valid (cold-built with an extra tx)
+        net.nodes[0].mempool.check_tx(b"locked=1")
+        vb = cs.executor.create_proposal_block(
+            2, cs.sm_state, cs._last_commit_for_proposal(),
+            cs.validators.get_proposer().address, cs.tx_source(),
+        )
+        cs.valid_round = 0
+        cs.valid_block = vb
+        cs.valid_block_id = block_id_for(vb)
+        hit0, _ = _spec_counts()
+        # a POL lock implies the round advanced past the POL round:
+        # propose at round 1 (pol_round=0), where the r==0 guard would
+        # discard the speculation even if the valid_block gate missed
+        cs.enter_new_round(2, 1)
+        _pump(net)
+        assert cs.height == 3
+        assert cs.decided[2] == block_id_for(vb)
+        hit1, _ = _spec_counts()
+        assert hit1 == hit0  # speculation never consulted
+        # kickoff for height 3 swept the leftover
+        with cs._spec_lock:
+            assert cs._spec is None or cs._spec.height == 3
+    finally:
+        _stop(net)
+
+
+def test_no_speculation_when_not_proposer(tmp_path):
+    """In a 2-validator net exactly one node proposes height 2 — only
+    that node runs the worker."""
+    net = InProcessNetwork(2, str(tmp_path), timeouts=SLOW)
+    try:
+        _drive_to_height_2(net)
+        speculated = []
+        for node in net.nodes:
+            cs = node.cs
+            is_proposer = (
+                cs.validators.get_proposer().address
+                == cs.privval.address()
+            )
+            t = cs._spec_thread
+            if t is not None:
+                t.join(10)
+            with cs._spec_lock:
+                has_spec = cs._spec is not None and cs._spec.height == 2
+            assert has_spec == is_proposer, (
+                f"node{node.idx}: proposer={is_proposer} spec={has_spec}"
+            )
+            speculated.append(has_spec)
+        assert sum(speculated) == 1
+    finally:
+        _stop(net)
+
+
+def test_speculation_live_single_validator(tmp_path):
+    """Threaded end-to-end: a live 1-validator net commits heights with
+    speculation enabled; hits accumulate and blocks stay canonical."""
+    hit0, _ = _spec_counts()
+    net = InProcessNetwork(1, str(tmp_path))
+    net.start()
+    try:
+        assert net.wait_for_height(4, timeout=30)
+    finally:
+        net.stop()
+    hit1, _ = _spec_counts()
+    assert hit1 > hit0, "no speculative proposal was consumed"
+    node = net.nodes[0]
+    for h in range(1, 4):
+        blk = node.block_store.load_block(h)
+        assert blk is not None
+        assert blk.hash() == node.cs.decided[h].hash

@@ -1,0 +1,134 @@
+"""Columnar tx batch (mempool/txcolumns.py): bit-exact equivalence with
+the list-of-bytes paths it replaces — Data.hash/encode, the default
+prepare_proposal byte-budget prefix, and mempool reap."""
+
+import pytest
+
+from cometbft_tpu.abci.types import Application
+from cometbft_tpu.crypto.keys import tmhash
+from cometbft_tpu.mempool.txcolumns import TxColumns
+from cometbft_tpu.types.block import Data
+
+
+def _cols(txs):
+    return TxColumns.from_txs(txs)
+
+
+TXS = [b"alpha", b"", b"x" * 300, b"\x00\x01\x02", b"last-tx"]
+
+
+def test_sequence_protocol_matches_list():
+    cols = _cols(TXS)
+    assert len(cols) == len(TXS)
+    assert list(cols) == TXS
+    assert [cols[i] for i in range(len(TXS))] == TXS
+    assert cols[-1] == TXS[-1]
+    assert cols[1:3] == TXS[1:3]
+    assert cols == TXS and cols == _cols(TXS)
+    assert cols != TXS[:-1]
+    assert cols.total_bytes() == sum(len(t) for t in TXS)
+
+
+def test_empty_batch():
+    cols = _cols([])
+    assert len(cols) == 0
+    assert list(cols) == []
+    assert cols.total_bytes() == 0
+    assert Data(cols).encode() == Data([]).encode()
+    assert Data(cols).hash() == Data([]).hash()
+
+
+def test_tx_hashes_match_tmhash():
+    cols = _cols(TXS)
+    assert cols.tx_hashes() == [tmhash(t) for t in TXS]
+
+
+def test_data_hash_and_encode_bit_exact():
+    """The Block's data_hash and wire bytes must not depend on whether
+    txs ride as a list or a TxColumns batch."""
+    cols = _cols(TXS)
+    assert Data(cols).hash() == Data(list(TXS)).hash()
+    assert Data(cols).encode() == Data(list(TXS)).encode()
+    # decode of the columnar encoding yields the original txs
+    assert Data.decode(Data(cols).encode()).txs == TXS
+
+
+def test_prefix_max_bytes_matches_loop():
+    cols = _cols(TXS)
+
+    def reference(max_tx_bytes):
+        out, total = [], 0
+        for tx in TXS:
+            total += len(tx)
+            if total > max_tx_bytes:
+                break
+            out.append(tx)
+        return out
+
+    for budget in range(0, cols.total_bytes() + 3):
+        assert list(cols.prefix_max_bytes(budget)) == reference(budget), budget
+
+
+def test_default_prepare_proposal_uses_columnar_prefix():
+    """Application.prepare_proposal budget-prefixes a TxColumns batch to
+    the same txs (and encoding) the per-tx loop produces on a list."""
+    app = Application()  # no abstract methods: defaults only
+    cols = _cols(TXS)
+    for budget in (0, 4, 305, 10_000):
+        got = app.prepare_proposal(cols, budget)
+        want = app.prepare_proposal(list(TXS), budget)
+        assert list(got) == want
+        assert Data(got).encode() == Data(want).encode()
+        assert Data(got).hash() == Data(want).hash()
+
+
+class _MemConn:
+    def check_tx(self, tx):
+        from cometbft_tpu.abci.types import CheckTxResult
+
+        return CheckTxResult()
+
+    def check_txs(self, txs):
+        return [self.check_tx(t) for t in txs]
+
+
+class _Conns:
+    def __init__(self):
+        self.mempool = _MemConn()
+
+
+def test_reap_columns_matches_reap_list():
+    from cometbft_tpu.mempool.mempool import CListMempool
+
+    mp = CListMempool(_Conns())
+    txs = [bytes([i]) * (10 + i) for i in range(20)]
+    for t in txs:
+        mp.check_tx(t)
+    for budget in (-1, 0, 35, 1000):
+        as_list = mp.reap_max_bytes_max_gas(max_bytes=budget)
+        as_cols = mp.reap_columns(max_bytes=budget)
+        assert isinstance(as_cols, TxColumns)
+        assert list(as_cols) == as_list
+
+
+def test_mempool_version_bumps():
+    from cometbft_tpu.mempool.mempool import CListMempool
+
+    mp = CListMempool(_Conns())
+    v0 = mp.version
+    mp.check_tx(b"tx-1")
+    assert mp.version > v0
+    v1 = mp.version
+    mp.update(1, [b"tx-1"], None)
+    assert mp.version > v1
+    v2 = mp.version
+    mp.flush()
+    assert mp.version > v2
+
+
+def test_views_are_zero_copy():
+    cols = _cols(TXS)
+    v = cols.view(2)
+    assert isinstance(v, memoryview)
+    assert bytes(v) == TXS[2]
+    assert [bytes(v) for v in cols.iter_views()] == TXS

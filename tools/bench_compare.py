@@ -141,11 +141,133 @@ def compare_file(relpath: str, ref: str, threshold: float) -> dict:
     return {"file": relpath, **diff_flat(base, cur, threshold)}
 
 
+def _ingest_record(flat_src: str):
+    """The ingest_sustained_load record (dict) from a WORKLOADS.json
+    body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        rec = data.get("ingest_sustained_load")
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+def compare_ingest(ref: str, threshold: float,
+                   relpath: str = "WORKLOADS.json") -> dict:
+    """Stage-by-stage diff of the sustained-ingest waterfall (ISSUE 11).
+
+    proposal_wait and commit-latency p99 are the first-class numbers —
+    the pipelined-proposer work exists to move exactly these — followed
+    by every waterfall stage's p50/p99. All stage keys are lower-better;
+    the direction machinery still runs so a renamed key can never
+    silently flip polarity."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _ingest_record(f.read())
+    base = _ingest_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no ingest_sustained_load record on one side"}
+
+    def stage_rows():
+        rows = []
+        b_stages = (base.get("stage_waterfall") or {}).get("stages") or {}
+        c_stages = (cur.get("stage_waterfall") or {}).get("stages") or {}
+        for name in c_stages:
+            if name not in b_stages:
+                continue
+            for q in ("p50_ms", "p99_ms"):
+                b = b_stages[name].get(q)
+                c = c_stages[name].get(q)
+                if not isinstance(b, (int, float)) or b == 0 \
+                        or not isinstance(c, (int, float)):
+                    continue
+                rel = (c - b) / abs(b)
+                rows.append({
+                    "stage": name, "quantile": q, "baseline": b,
+                    "current": c, "change_pct": round(rel * 100, 1),
+                    "direction": direction(q),
+                    "worse": rel > threshold,
+                    "better": rel < -threshold,
+                })
+        return rows
+
+    def headline(path: tuple, label: str):
+        b, c = base, cur
+        for p in path:
+            b = (b or {}).get(p) if isinstance(b, dict) else None
+            c = (c or {}).get(p) if isinstance(c, dict) else None
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            return None
+        rel = (c - b) / abs(b) if b else 0.0
+        return {"key": label, "baseline": b, "current": c,
+                "change_pct": round(rel * 100, 1),
+                "worse": b != 0 and rel > threshold,
+                "better": b != 0 and rel < -threshold}
+
+    headlines = [h for h in (
+        headline(("stage_waterfall", "stages", "proposal_wait", "p99_ms"),
+                 "proposal_wait_p99_ms"),
+        headline(("commit_latency_ms", "p99"), "commit_p99_ms"),
+        headline(("commit_latency_ms", "p50"), "commit_p50_ms"),
+        headline(("txs_per_sec",), "txs_per_sec"),
+    ) if h is not None]
+    # throughput is higher-better: flip the verdict computed above
+    for h in headlines:
+        if h["key"] == "txs_per_sec":
+            h["worse"], h["better"] = h["better"], h["worse"]
+    stages = stage_rows()
+    return {
+        "file": relpath, "mode": "ingest_waterfall",
+        "dominant_stage_p99": {
+            "baseline": (base.get("stage_waterfall") or {}).get(
+                "dominant_stage_p99"),
+            "current": (cur.get("stage_waterfall") or {}).get(
+                "dominant_stage_p99"),
+        },
+        "headlines": headlines,
+        "stages": stages,
+        "regressions": [r for r in headlines + stages if r.get("worse")],
+        "improvements": [r for r in headlines + stages if r.get("better")],
+    }
+
+
+def _print_ingest(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"ingest waterfall: skipped ({rep['skipped']})")
+        return
+    dom = rep["dominant_stage_p99"]
+    print(f"ingest waterfall ({rep['file']}): dominant p99 stage "
+          f"{dom['baseline']} -> {dom['current']}")
+    for h in rep["headlines"]:
+        tag = ("REGRESSION" if h["worse"]
+               else "improved  " if h["better"] else "          ")
+        print("  %s %-24s %10g -> %-10g (%+.1f%%)"
+              % (tag, h["key"], h["baseline"], h["current"],
+                 h["change_pct"]))
+    for r in rep["stages"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-13s %-7s %10g -> %-10g (%+.1f%%)"
+              % (tag, r["stage"], r["quantile"], r["baseline"],
+                 r["current"], r["change_pct"]))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff fresh bench/workload JSON against the last "
                     "committed round")
     ap.add_argument("--files", nargs="+", default=list(DEFAULT_FILES))
+    ap.add_argument("--ingest", action="store_true",
+                    help="also diff the sustained-ingest stage waterfall "
+                         "stage-by-stage (proposal_wait / commit p99 "
+                         "first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -159,10 +281,16 @@ def main(argv=None) -> int:
 
     reports = [compare_file(f, args.ref, args.threshold)
                for f in args.files]
+    ingest_rep = (compare_ingest(args.ref, args.threshold)
+                  if args.ingest else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
+    if ingest_rep is not None:
+        n_reg += len(ingest_rep.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
                "advisory": args.advisory, "total_regressions": n_reg,
                "files": reports}
+    if ingest_rep is not None:
+        summary["ingest_waterfall"] = ingest_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -182,6 +310,8 @@ def main(argv=None) -> int:
                 print("  improved   %-52s %12g -> %-12g (%+.1f%%)"
                       % (row["key"], row["baseline"], row["current"],
                          row["change_pct"]))
+        if ingest_rep is not None:
+            _print_ingest(ingest_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "
