@@ -16,19 +16,22 @@ from .keys import BatchVerifier, PubKey
 def create_batch_verifier(pub_key: PubKey, backend: str = "tpu") -> BatchVerifier | None:
     """A fresh batch verifier for this key's type, or None if the type
     has no batch support."""
-    from . import ed25519, sr25519
+    from . import bls, ed25519, sr25519
 
     tag = pub_key.type_tag()
     if tag == ed25519.KEY_TYPE:
         return ed25519.Ed25519BatchVerifier(backend=backend)
     if tag == sr25519.KEY_TYPE:
         return sr25519.Sr25519BatchVerifier(backend=backend)
+    if tag == bls.KEY_TYPE:
+        return bls.BlsBatchVerifier(backend=backend)
     return None
 
 
 def supports_batch_verifier(pub_key: PubKey | None) -> bool:
     if pub_key is None:
         return False
-    from . import ed25519, sr25519
+    from . import bls, ed25519, sr25519
 
-    return pub_key.type_tag() in (ed25519.KEY_TYPE, sr25519.KEY_TYPE)
+    return pub_key.type_tag() in (
+        ed25519.KEY_TYPE, sr25519.KEY_TYPE, bls.KEY_TYPE)

@@ -32,6 +32,7 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "rlc_packer.inc"),
     os.path.join(os.path.dirname(_SRC), "secp256k1.inc"),
     os.path.join(os.path.dirname(_SRC), "sr25519_native.inc"),
+    os.path.join(os.path.dirname(_SRC), "bls12_381.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -194,6 +195,61 @@ def _bind(lib) -> None:
     lib.sr25519_batch_verify.argtypes = [
         ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.bls_engine.restype = ctypes.c_int
+    lib.bls_engine.argtypes = []
+    lib.bls_pubkey.restype = ctypes.c_int
+    lib.bls_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.bls_sign.restype = ctypes.c_int
+    lib.bls_sign.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.bls_verify.restype = ctypes.c_int
+    lib.bls_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.bls_hash_to_g2.restype = ctypes.c_int
+    lib.bls_hash_to_g2.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.bls_g1_decompress.restype = ctypes.c_int
+    lib.bls_g1_decompress.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.bls_g2_decompress.restype = ctypes.c_int
+    lib.bls_g2_decompress.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.bls_g1_subgroup_check.restype = ctypes.c_int
+    lib.bls_g1_subgroup_check.argtypes = [ctypes.c_char_p]
+    lib.bls_g2_subgroup_check.restype = ctypes.c_int
+    lib.bls_g2_subgroup_check.argtypes = [ctypes.c_char_p]
+    lib.bls_pairing.restype = ctypes.c_int
+    lib.bls_pairing.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.bls_aggregate_sigs.restype = ctypes.c_int
+    lib.bls_aggregate_sigs.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.bls_aggregate_pubkeys.restype = ctypes.c_int
+    lib.bls_aggregate_pubkeys.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.bls_aggregate_verify.restype = ctypes.c_int
+    lib.bls_aggregate_verify.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32),                    # gids
+        ctypes.c_uint64, ctypes.c_char_p,                   # k, msgs blob
+        ctypes.POINTER(ctypes.c_uint64),                    # msg_lens
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,     # dst, nchunks
+    ]
+    lib.bls_cert_verify.restype = ctypes.c_int
+    lib.bls_cert_verify.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,  # n, pubs, bitmap
+        ctypes.c_char_p, ctypes.c_uint64,                   # msg
+        ctypes.c_char_p,                                    # agg sig
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,     # dst, nchunks
     ]
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
@@ -611,6 +667,190 @@ def sr25519_challenge(pub: bytes, msg: bytes, r32: bytes) -> bytes | None:
     out = ctypes.create_string_buffer(32)
     lib.sr25519_challenge(pub, msg, len(msg), r32, out)
     return out.raw
+
+
+def bls_available() -> bool:
+    """True when the .so exports the BLS12-381 pairing unit."""
+    lib = get_lib()
+    return (lib is not None and hasattr(lib, "bls_engine")
+            and bool(lib.bls_engine()))
+
+
+def bls_pubkey(sk32: bytes) -> bytes | None:
+    """48-byte compressed G1 pubkey for a 32-byte BE scalar; None when
+    the lib is absent or the scalar is out of [1, r) (caller falls back
+    to the Python oracle)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_pubkey"):
+        return None
+    out = ctypes.create_string_buffer(48)
+    if not lib.bls_pubkey(sk32, out):
+        return None
+    return out.raw
+
+
+def bls_sign(sk32: bytes, msg: bytes, dst: bytes) -> bytes | None:
+    """96-byte compressed G2 signature [sk]H(msg); None when the lib is
+    absent or the scalar is invalid."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_sign"):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if not lib.bls_sign(sk32, msg, len(msg), dst, len(dst), out):
+        return None
+    return out.raw
+
+
+def bls_verify(pub: bytes, msg: bytes, sig: bytes,
+               dst: bytes) -> bool | None:
+    """One native BLS verify (KeyValidate + sig subgroup + 2-pair
+    product); None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_verify"):
+        return None
+    return bool(lib.bls_verify(pub, msg, len(msg), dst, len(dst), sig))
+
+
+def bls_hash_to_g2(msg: bytes, dst: bytes) -> bytes | None:
+    """96-byte compressed RFC 9380 hash_to_curve output; None when the
+    lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_hash_to_g2"):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if not lib.bls_hash_to_g2(msg, len(msg), dst, len(dst), out):
+        return None
+    return out.raw
+
+
+def bls_g1_decompress(b48: bytes):
+    """Native G1 decode: (x int, y int) affine, "inf", False on a
+    rejected encoding, None when the lib is absent. Differential
+    surface for the canonicality rules."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_g1_decompress"):
+        return None
+    out = ctypes.create_string_buffer(96)
+    rc = lib.bls_g1_decompress(b48, out)
+    if rc == 2:
+        return "inf"
+    if rc != 1:
+        return False
+    return (int.from_bytes(out.raw[:48], "big"),
+            int.from_bytes(out.raw[48:], "big"))
+
+
+def bls_g2_decompress(b96: bytes):
+    """Native G2 decode: ((x0,x1),(y0,y1)) affine, "inf", False on a
+    rejected encoding, None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_g2_decompress"):
+        return None
+    out = ctypes.create_string_buffer(192)
+    rc = lib.bls_g2_decompress(b96, out)
+    if rc == 2:
+        return "inf"
+    if rc != 1:
+        return False
+    c = [int.from_bytes(out.raw[i * 48:(i + 1) * 48], "big")
+         for i in range(4)]
+    return ((c[0], c[1]), (c[2], c[3]))
+
+
+def bls_g1_subgroup_check(b48: bytes) -> int | None:
+    """1 = in the r-order subgroup, 0 = on curve but not, 2 = infinity,
+    -1 = decode failure; None when the lib is absent. The native check
+    is the fast endomorphism one — differentially pinned against the
+    oracle's naive [r]P."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_g1_subgroup_check"):
+        return None
+    return int(lib.bls_g1_subgroup_check(b48))
+
+
+def bls_g2_subgroup_check(b96: bytes) -> int | None:
+    """Same contract as bls_g1_subgroup_check for G2 (psi-endomorphism
+    fast check natively)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_g2_subgroup_check"):
+        return None
+    return int(lib.bls_g2_subgroup_check(b96))
+
+
+def bls_pairing(p48: bytes, q96: bytes) -> bytes | bool | None:
+    """Serialized GT element e(P, Q) (576 bytes, 12 Fp coords BE) —
+    pins the native Miller loop + final exponentiation bit-for-bit
+    against the oracle. False on invalid/out-of-subgroup inputs; None
+    when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_pairing"):
+        return None
+    out = ctypes.create_string_buffer(576)
+    if not lib.bls_pairing(p48, q96, out):
+        return False
+    return out.raw
+
+
+def bls_aggregate_sigs(blob: bytes, n: int,
+                       nchunks: int = 0) -> bytes | None:
+    """Sum n 96-byte G2 signatures across the worker pool -> one
+    96-byte aggregate. None when the lib is absent OR any input fails
+    decode/subgroup — the caller's Python rescan then produces the
+    (identical) rejection."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_aggregate_sigs"):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if not lib.bls_aggregate_sigs(n, blob, nchunks, out):
+        return None
+    return out.raw
+
+
+def bls_aggregate_pubkeys(blob: bytes, n: int, bitmap: bytes,
+                          nchunks: int = 0) -> bytes | None:
+    """Aggregate pubkey over a signer bitmap in one native call
+    (KeyValidate per participant, identity aggregate rejected). None
+    when the lib is absent or the aggregate is invalid (Python rescan
+    reproduces the rejection)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_aggregate_pubkeys"):
+        return None
+    out = ctypes.create_string_buffer(48)
+    if not lib.bls_aggregate_pubkeys(n, blob, bitmap, nchunks, out):
+        return None
+    return out.raw
+
+
+def bls_aggregate_verify(pubs_blob: bytes, sigs_blob: bytes, n: int,
+                         gids, msgs, dst: bytes,
+                         nchunks: int = 0) -> bool | None:
+    """n (pub, msg, sig) triples -> ONE native product-of-pairings
+    check. `gids[i]` names the message group of item i; `msgs` lists
+    the k distinct messages in group order. None when the lib is
+    absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_aggregate_verify"):
+        return None
+    k = len(msgs)
+    gid_arr = (ctypes.c_uint32 * max(n, 1))(*gids)
+    msg_lens = (ctypes.c_uint64 * max(k, 1))(*(len(m) for m in msgs))
+    return bool(lib.bls_aggregate_verify(
+        n, pubs_blob, sigs_blob, gid_arr, k, b"".join(msgs), msg_lens,
+        dst, len(dst), nchunks))
+
+
+def bls_cert_verify(pubs_blob: bytes, n: int, bitmap: bytes,
+                    msg: bytes, agg_sig: bytes, dst: bytes,
+                    nchunks: int = 0) -> bool | None:
+    """Aggregate-certificate verify in one call: pool-parallel apk over
+    the bitmap + e(apk, H(msg)) == e(g1, agg_sig). None when the lib is
+    absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bls_cert_verify"):
+        return None
+    return bool(lib.bls_cert_verify(
+        n, pubs_blob, bitmap, msg, len(msg), agg_sig,
+        dst, len(dst), nchunks))
 
 
 def sr25519_ristretto_decode(enc: bytes):

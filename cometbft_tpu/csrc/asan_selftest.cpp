@@ -61,6 +61,22 @@ int sr25519_batch_residue(u64 n, const u8 *ss, const u8 *cs, const u8 *zs,
                           u8 *out_zc, u8 *out_zsum);
 int sr25519_batch_verify(u64 n, const u8 *pubs, const u8 *msgs,
                          const u64 *msg_lens, const u8 *sigs, const u8 *zs);
+int bls_engine(void);
+int bls_pubkey(const u8 *sk32, u8 *out48);
+int bls_sign(const u8 *sk32, const u8 *msg, u64 mlen, const u8 *dst,
+             u64 dlen, u8 *out96);
+int bls_hash_to_g2(const u8 *msg, u64 mlen, const u8 *dst, u64 dlen,
+                   u8 *out96);
+int bls_verify(const u8 *pub48, const u8 *msg, u64 mlen, const u8 *dst,
+               u64 dlen, const u8 *sig96);
+int bls_g1_subgroup_check(const u8 *in48);
+int bls_g2_subgroup_check(const u8 *in96);
+int bls_aggregate_sigs(u64 n, const u8 *blob, int nchunks, u8 *out96);
+int bls_aggregate_pubkeys(u64 n, const u8 *blob, const u8 *bitmap,
+                          int nchunks, u8 *out48);
+int bls_cert_verify(u64 n, const u8 *pubs, const u8 *bitmap, const u8 *msg,
+                    u64 mlen, const u8 *agg_sig96, const u8 *dst, u64 dlen,
+                    int nchunks);
 }
 
 // deterministic PRNG for the fuzz loops (no OS entropy in the harness)
@@ -537,6 +553,161 @@ static int rlc_packer_checks() {
     return 0;
 }
 
+// -- BLS12-381 pairing engine surface -------------------------------------
+//
+// Keys are derived natively (unlike secp/sr there IS a native signer),
+// so the whole accept path is synthesized in place: keygen -> sign ->
+// PoP -> pooled aggregation -> the single cert pairing check. Per-key
+// pairing verifies are capped at 3 (a pairing under ASAN costs real
+// time); the 128-key "max-size" shape is covered by aggregation plus
+// ONE cert check instead.
+
+static const char BLS_DST[] = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_";
+static const char BLS_POP[] = "BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_";
+
+static int bls_checks() {
+    if (bls_engine() < 1) {
+        printf("FAIL: bls_engine < 1\n");
+        return 1;
+    }
+    const u8 *dst = (const u8 *)BLS_DST;
+    const u64 dlen = sizeof(BLS_DST) - 1;
+    const u8 *pop_dst = (const u8 *)BLS_POP;
+    const u64 plen = sizeof(BLS_POP) - 1;
+    const u8 msg[] = "asan bls aggregate vector";
+    const u64 mlen = sizeof(msg) - 1;
+    const int N = 128;  // max-size aggregation shape, tight buffers
+    std::vector<u8> sks(N * 32, 0), pubs(N * 48), sigs(N * 96);
+    for (int i = 0; i < N; i++) {
+        // deterministic scalars, all nonzero and far below the order
+        sks[i * 32 + 30] = (u8)(i + 1);
+        sks[i * 32 + 31] = (u8)(i * 7 + 3);
+        if (!bls_pubkey(&sks[i * 32], &pubs[i * 48]) ||
+            !bls_sign(&sks[i * 32], msg, mlen, dst, dlen, &sigs[i * 96])) {
+            printf("FAIL: bls keygen/sign %d\n", i);
+            return 1;
+        }
+        if (i < 3 && !bls_verify(&pubs[i * 48], msg, mlen, dst, dlen,
+                                 &sigs[i * 96])) {
+            printf("FAIL: bls valid signature %d rejected\n", i);
+            return 1;
+        }
+    }
+    // zero scalar (outside [1, r)) must decline keygen and sign
+    u8 zsk[32], tmp96[96];
+    memset(zsk, 0, 32);
+    u8 tmp48[48];
+    if (bls_pubkey(zsk, tmp48) || bls_sign(zsk, msg, mlen, dst, dlen,
+                                           tmp96)) {
+        printf("FAIL: bls zero scalar accepted\n");
+        return 1;
+    }
+    // proof-of-possession: sign own pubkey bytes under the POP dst;
+    // verifies for the owner, rejects under the wrong key
+    u8 pop[96];
+    if (!bls_sign(sks.data(), pubs.data(), 48, pop_dst, plen, pop) ||
+        !bls_verify(pubs.data(), pubs.data(), 48, pop_dst, plen, pop)) {
+        printf("FAIL: bls PoP cycle\n");
+        return 1;
+    }
+    if (bls_verify(&pubs[48], &pubs[48], 48, pop_dst, plen, pop)) {
+        printf("FAIL: bls PoP accepted for wrong key\n");
+        return 1;
+    }
+    // hash-to-curve: deterministic, lands in the r-order subgroup
+    u8 h1[96], h2[96];
+    if (!bls_hash_to_g2(msg, mlen, dst, dlen, h1) ||
+        !bls_hash_to_g2(msg, mlen, dst, dlen, h2) ||
+        memcmp(h1, h2, 96) != 0 || bls_g2_subgroup_check(h1) != 1) {
+        printf("FAIL: bls hash_to_g2\n");
+        return 1;
+    }
+    // n == 0 aggregates decline without touching output buffers
+    u8 agg[96], apk[48];
+    if (bls_aggregate_sigs(0, nullptr, 0, agg) != 0 ||
+        bls_aggregate_pubkeys(0, nullptr, nullptr, 0, apk) != 0) {
+        printf("FAIL: bls aggregate(n=0) != 0\n");
+        return 1;
+    }
+    // infinity encodings: subgroup checks report rc 2; the identity
+    // pubkey fails KeyValidate inside aggregation; the all-infinity
+    // SIGNATURE aggregate is representable (and then unverifiable)
+    u8 inf48[48], inf96[96];
+    memset(inf48, 0, 48); inf48[0] = 0xc0;
+    memset(inf96, 0, 96); inf96[0] = 0xc0;
+    u8 one_bit = 0x01;
+    if (bls_g1_subgroup_check(inf48) != 2 ||
+        bls_g2_subgroup_check(inf96) != 2 ||
+        bls_aggregate_pubkeys(1, inf48, &one_bit, 0, apk) != 0) {
+        printf("FAIL: bls identity-point handling\n");
+        return 1;
+    }
+    if (bls_aggregate_sigs(1, inf96, 0, agg) != 1 ||
+        memcmp(agg, inf96, 96) != 0 ||
+        bls_verify(pubs.data(), msg, mlen, dst, dlen, agg)) {
+        printf("FAIL: bls infinity-signature aggregate\n");
+        return 1;
+    }
+    // P + (-P): the Zcash sort flag (0x20) toggles negation, so two
+    // copies of a key with opposite flags aggregate to the identity —
+    // the degenerate apk a rogue-key split lands on; must decline
+    u8 pm[96];
+    memcpy(pm, pubs.data(), 48);
+    memcpy(pm + 48, pubs.data(), 48);
+    pm[48] ^= 0x20;
+    u8 both = 0x03;
+    if (bls_aggregate_pubkeys(2, pm, &both, 0, apk) != 0) {
+        printf("FAIL: bls P + -P aggregate accepted\n");
+        return 1;
+    }
+    // non-canonical encodings: missing compression flag, x >= p
+    u8 bad[48];
+    memcpy(bad, pubs.data(), 48);
+    bad[0] &= 0x7f;
+    u8 big[48]; memset(big, 0xff, 48); big[0] = 0x9f;
+    if (bls_g1_subgroup_check(bad) != -1 ||
+        bls_g1_subgroup_check(big) != -1) {
+        printf("FAIL: bls non-canonical encoding accepted\n");
+        return 1;
+    }
+    // max-size aggregation: byte-identical across chunk counts, and the
+    // whole column collapses to one passing cert check
+    std::vector<u8> bitmap(N / 8, 0xff);
+    u8 agg2[96], apk2[48];
+    if (bls_aggregate_sigs(N, sigs.data(), 0, agg) != 1 ||
+        bls_aggregate_pubkeys(N, pubs.data(), bitmap.data(), 0, apk) != 1) {
+        printf("FAIL: bls max-size aggregation\n");
+        return 1;
+    }
+    for (int nc : {1, 3, 8}) {
+        if (bls_aggregate_sigs(N, sigs.data(), nc, agg2) != 1 ||
+            bls_aggregate_pubkeys(N, pubs.data(), bitmap.data(), nc,
+                                  apk2) != 1 ||
+            memcmp(agg, agg2, 96) != 0 || memcmp(apk, apk2, 48) != 0) {
+            printf("FAIL: bls aggregation not chunk-deterministic "
+                   "(nc=%d)\n", nc);
+            return 1;
+        }
+    }
+    if (bls_cert_verify(N, pubs.data(), bitmap.data(), msg, mlen, agg,
+                        dst, dlen, 0) != 1) {
+        printf("FAIL: bls cert over full bitmap rejected\n");
+        return 1;
+    }
+    // one signer covered a different message: aggregate still decodes,
+    // the cert pairing check must fail
+    if (!bls_sign(&sks[7 * 32], h1, 96, dst, dlen, &sigs[7 * 96]) ||
+        bls_aggregate_sigs(N, sigs.data(), 0, agg) != 1 ||
+        bls_cert_verify(N, pubs.data(), bitmap.data(), msg, mlen, agg,
+                        dst, dlen, 0) != 0) {
+        printf("FAIL: bls wrong-message cert accepted\n");
+        return 1;
+    }
+    printf("asan bls12-381 checks ok (PoP, identity points, n==0, "
+           "max-size aggregation, cert pairing)\n");
+    return 0;
+}
+
 int main() {
     const int N = 96;
     std::vector<u8> pubs(N * 32), sigs(N * 64), msgs;
@@ -581,6 +752,7 @@ int main() {
     if (rlc_packer_checks() != 0) return 1;
     if (secp256k1_checks() != 0) return 1;
     if (sr25519_checks() != 0) return 1;
+    if (bls_checks() != 0) return 1;
     printf("asan selftest ok (%d signatures, threaded batch)\n", N);
     return 0;
 }

@@ -1350,3 +1350,7 @@ void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
 // decode, mod-L residue (own extern "C" exports; uses the fe/sc/ge
 // cores, keccak_f1600 and edwards_msm_is_identity from this TU)
 #include "sr25519_native.inc"
+
+// BLS12-381 pairing engine — aggregate-signature track (own extern "C"
+// exports; uses sha256n from merkle_native.inc, pool from rlc_packer.inc)
+#include "bls12_381.inc"

@@ -25,6 +25,7 @@ import tempfile
 from dataclasses import dataclass
 
 from ..crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+from ..crypto.keys import PrivKey, PubKey
 from ..types.basic import Timestamp
 from ..types.vote import (
     SignedMsgType,
@@ -45,6 +46,21 @@ class SignStep(enum.IntEnum):
 
 
 _PRECOMMIT_TYPE = SignedMsgType.PRECOMMIT
+
+
+def _priv_key_class(key_type: str):
+    """Key-file "key_type" tag -> PrivKey class. Ed25519 is the default
+    (and the tag older key files lack); BLS validators sign votes with
+    the same file format, so consensus signing keys stay swappable."""
+    if key_type == "tendermint/PubKeyBls12_381":
+        from ..crypto.bls import BlsPrivKey
+
+        return BlsPrivKey
+    if key_type == "tendermint/PubKeySecp256k1":
+        from ..crypto.secp256k1 import Secp256k1PrivKey
+
+        return Secp256k1PrivKey
+    return Ed25519PrivKey
 
 _VOTE_TO_STEP = {
     SignedMsgType.PREVOTE: SignStep.PREVOTE,
@@ -86,7 +102,7 @@ class _LastSignState:
 class FilePV:
     """types.PrivValidator backed by key + state files."""
 
-    def __init__(self, priv_key: Ed25519PrivKey, key_path: str | None,
+    def __init__(self, priv_key: PrivKey, key_path: str | None,
                  state_path: str | None):
         self._priv = priv_key
         self._key_path = key_path
@@ -97,9 +113,9 @@ class FilePV:
 
     # ------------------------------------------------------------------
     @classmethod
-    def generate(cls, key_path: str | None = None, state_path: str | None = None
-                 ) -> "FilePV":
-        pv = cls(Ed25519PrivKey.generate(), key_path, state_path)
+    def generate(cls, key_path: str | None = None, state_path: str | None = None,
+                 key_type: str = "tendermint/PubKeyEd25519") -> "FilePV":
+        pv = cls(_priv_key_class(key_type).generate(), key_path, state_path)
         if key_path:
             pv._save_key()
         if state_path:
@@ -110,7 +126,8 @@ class FilePV:
     def load(cls, key_path: str, state_path: str) -> "FilePV":
         with open(key_path) as f:
             d = json.load(f)
-        return cls(Ed25519PrivKey(bytes.fromhex(d["priv_key"])), key_path, state_path)
+        klass = _priv_key_class(d.get("key_type", "tendermint/PubKeyEd25519"))
+        return cls(klass(bytes.fromhex(d["priv_key"])), key_path, state_path)
 
     def _save_key(self):
         pub = self._priv.pub_key()
@@ -118,6 +135,7 @@ class FilePV:
             "address": pub.address().hex(),
             "pub_key": pub.bytes().hex(),
             "priv_key": self._priv.bytes().hex(),
+            "key_type": self._priv.type_tag(),
         })
 
     @staticmethod
@@ -140,7 +158,7 @@ class FilePV:
             })
 
     # ------------------------------------------------------------------
-    def pub_key(self) -> Ed25519PubKey:
+    def pub_key(self) -> PubKey:
         return self._priv.pub_key()
 
     def address(self) -> bytes:

@@ -1,0 +1,175 @@
+"""Aggregate-commit certificate: the BLS compact replacement for a
+full Commit's signature column.
+
+A 10k-validator Commit carries 10k * 64-byte ed25519 signatures
+(~640 KB on the wire, thousands of scalar multiplications to check).
+When every validator key is BLS12-381, the same +2/3 evidence
+compresses to ONE 96-byte aggregate signature plus a signer bitmap
+(1250 bytes at 10k validators), and verification is a single
+product-of-pairings check over the pool-aggregated apk
+(crypto/bls.cert_verify -> csrc bls_cert_verify).
+
+The certificate signs ONE canonical precommit message: unlike a
+Commit, whose per-slot timestamps make each validator's sign-bytes
+unique, the certificate carries a single canonical timestamp (PBTS
+style — the proposal timestamp all precommits adopt). from_commit
+therefore requires the source commit's COMMIT slots to share one
+timestamp; vote-time aggregation paths construct certificates
+directly from uniform-timestamp precommits.
+
+Wire format (proto-shaped like the rest of types/): height=1 (sfixed64),
+round=2 (sfixed64), block_id=3, timestamp=4, bitmap=5, agg_sig=6.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..encoding import proto as pb
+from .basic import BlockID, Timestamp
+from .block import BlockIDFlag, Commit
+from .vote import SignedMsgType, canonical_vote_bytes
+
+ZERO_TIME = Timestamp(0, 0)
+
+BLS_SIG_SIZE = 96
+
+
+class AggCommitError(Exception):
+    pass
+
+
+@dataclass
+class AggregateCommit:
+    """+2/3 precommit evidence as one aggregate signature."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Timestamp = ZERO_TIME
+    bitmap: bytes = b""
+    agg_sig: bytes = b""
+
+    # ------------------------------------------------------------------
+    def signer_count(self) -> int:
+        return sum(bin(b).count("1") for b in self.bitmap)
+
+    def has_signer(self, idx: int) -> bool:
+        byte = idx >> 3
+        return byte < len(self.bitmap) and bool(
+            (self.bitmap[byte] >> (idx & 7)) & 1
+        )
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        """The one canonical precommit message every signer covered."""
+        return canonical_vote_bytes(
+            SignedMsgType.PRECOMMIT, self.height, self.round,
+            self.block_id, self.timestamp, chain_id,
+        )
+
+    def wire_size(self) -> int:
+        return len(self.encode())
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_commit(cls, commit: Commit) -> "AggregateCommit":
+        """Fold a uniform-timestamp all-BLS Commit into a certificate.
+
+        Aggregates the COMMIT slots' signatures across the worker pool;
+        raises AggCommitError when slots disagree on timestamp (the
+        certificate signs one message) or when any signature fails
+        G2 decode/subgroup."""
+        from ..crypto import bls
+
+        sigs = []
+        bitmap = bytearray((len(commit.signatures) + 7) // 8)
+        ts = None
+        for i, cs in enumerate(commit.signatures):
+            if cs.block_id_flag != BlockIDFlag.COMMIT:
+                continue
+            if ts is None:
+                ts = cs.timestamp
+            elif cs.timestamp != ts:
+                raise AggCommitError(
+                    "commit timestamps are not uniform; certificate "
+                    "signs a single canonical message"
+                )
+            sigs.append(cs.signature)
+            bitmap[i >> 3] |= 1 << (i & 7)
+        if not sigs:
+            raise AggCommitError("no COMMIT votes to aggregate")
+        agg = bls.aggregate_signatures(sigs)
+        if agg is None:
+            raise AggCommitError("signature failed G2 decode/subgroup")
+        return cls(commit.height, commit.round, commit.block_id,
+                   ts, bytes(bitmap), agg)
+
+    # ------------------------------------------------------------------
+    def verify(self, chain_id: str, vals, nchunks: int = 0) -> None:
+        """Check the certificate against a validator set: +2/3 of the
+        set's power signed the canonical precommit for this block —
+        exactly ONE pairing check regardless of signer count.
+
+        PoP for every key was enforced when the set was built
+        (types/genesis.py), so aggregation is rogue-key safe. Raises
+        AggCommitError on any failure."""
+        from ..crypto import bls
+
+        n = len(vals)
+        if len(self.bitmap) != (n + 7) // 8:
+            raise AggCommitError(
+                f"bitmap size {len(self.bitmap)} != validator set "
+                f"size {n}")
+        # no phantom bits past the set
+        if n % 8 and self.bitmap[-1] >> (n % 8):
+            raise AggCommitError("bitmap has bits beyond the set")
+        pubs = []
+        tally = 0
+        for i in range(n):
+            v = vals.get_by_index(i)
+            if v.pub_key.type_tag() != bls.KEY_TYPE:
+                raise AggCommitError(
+                    f"validator {i} is not BLS; aggregate certificate "
+                    "requires an all-BLS set")
+            pubs.append(v.pub_key.bytes())
+            if self.has_signer(i):
+                tally += v.voting_power
+        threshold = vals.total_voting_power() * 2 // 3
+        if tally <= threshold:
+            raise AggCommitError(
+                f"certificate power {tally} <= threshold {threshold}")
+        if not bls.cert_verify(pubs, self.bitmap,
+                               self.sign_bytes(chain_id), self.agg_sig,
+                               nchunks=nchunks):
+            raise AggCommitError("aggregate signature invalid")
+
+    # ------------------------------------------------------------------
+    def encode(self) -> bytes:
+        return (
+            pb.f_sfixed64(1, self.height)
+            + pb.f_sfixed64(2, self.round)
+            + pb.f_embedded(3, self.block_id.encode())
+            + pb.f_embedded(4, self.timestamp.encode())
+            + pb.f_bytes(5, self.bitmap)
+            + pb.f_bytes(6, self.agg_sig)
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "AggregateCommit":
+        d = pb.fields_to_dict(buf)
+        sig = pb.as_bytes(d.get(6, b""))
+        if len(sig) != BLS_SIG_SIZE:
+            raise AggCommitError("bad aggregate signature size")
+        h, r = d.get(1, 0), d.get(2, 0)
+        if not isinstance(h, int) or not isinstance(r, int):
+            # int(bytes) parses ASCII digits — same type-confusion trap
+            # as_bytes guards in the other direction
+            raise AggCommitError("expected fixed64 height/round")
+        return cls(
+            height=pb.to_i64(h),
+            round=pb.to_i64(r),
+            block_id=BlockID.decode(pb.as_bytes(d.get(3, b""))),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(4, b""))),
+            bitmap=pb.as_bytes(d.get(5, b"")),
+            agg_sig=sig,
+        )

@@ -12,12 +12,28 @@ from dataclasses import dataclass, field
 
 from ..crypto.ed25519 import Ed25519PubKey
 
+# Exact key-type -> pubkey size. The old check was a substring test
+# ("Secp256k1 in type ? 33 : 32") that silently measured any future key
+# type against ed25519's 32 bytes; BLS12-381's 48-byte G1 keys made it
+# load-bearing to dispatch on the full tag.
+PUB_KEY_SIZES = {
+    "tendermint/PubKeyEd25519": 32,
+    "tendermint/PubKeySecp256k1": 33,
+    "tendermint/PubKeyBls12_381": 48,
+}
+
+BLS_KEY_TYPE = "tendermint/PubKeyBls12_381"
+
 
 def _genesis_pub_key(gv):
-    if "Secp256k1" in gv.pub_key_type:
+    if gv.pub_key_type == "tendermint/PubKeySecp256k1":
         from ..crypto.secp256k1 import Secp256k1PubKey
 
         return Secp256k1PubKey(gv.pub_key_bytes)
+    if gv.pub_key_type == BLS_KEY_TYPE:
+        from ..crypto.bls import BlsPubKey
+
+        return BlsPubKey(gv.pub_key_bytes)
     return Ed25519PubKey(gv.pub_key_bytes)
 from .basic import Timestamp
 from .validator_set import Validator, ValidatorSet
@@ -31,6 +47,9 @@ class GenesisValidator:
     power: int
     name: str = ""
     pub_key_type: str = "tendermint/PubKeyEd25519"
+    # BLS12-381 only: proof-of-possession over the pubkey bytes (rogue-key
+    # defense for the aggregate path); checked at validator-set construction
+    pop: bytes = b""
 
 
 @dataclass
@@ -58,10 +77,8 @@ class GenesisDoc:
         for gv in self.validators:
             if gv.power < 0:
                 raise ValueError("genesis: negative validator power")
-            if gv.pub_key_type not in (
-                "tendermint/PubKeyEd25519",
-                "tendermint/PubKeySecp256k1",
-            ):
+            want = PUB_KEY_SIZES.get(gv.pub_key_type)
+            if want is None:
                 # sr25519 keys sign votes but have no proto PublicKey
                 # representation, so they cannot appear in validator
                 # sets (matches reference crypto/encoding/codec.go)
@@ -69,13 +86,30 @@ class GenesisDoc:
                     f"genesis: validator key type {gv.pub_key_type!r} "
                     "not supported in validator sets"
                 )
-            want = 33 if "Secp256k1" in gv.pub_key_type else 32
             if len(gv.pub_key_bytes) != want:
                 raise ValueError(
-                    f"genesis: bad {gv.pub_key_type} pubkey size"
+                    f"genesis: bad {gv.pub_key_type} pubkey size "
+                    f"(want {want}, got {len(gv.pub_key_bytes)})"
+                )
+            if gv.pub_key_type == BLS_KEY_TYPE and not gv.pop:
+                raise ValueError(
+                    "genesis: BLS12-381 validator missing proof-of-"
+                    "possession"
                 )
 
     def validator_set(self) -> ValidatorSet:
+        # PoP gate: a BLS key enters the set only with a valid
+        # proof-of-possession — without it, aggregate verification is
+        # open to rogue-key cancellation.
+        for gv in self.validators:
+            if gv.pub_key_type == BLS_KEY_TYPE:
+                from ..crypto import bls
+
+                if not bls.pop_verify(gv.pub_key_bytes, gv.pop):
+                    raise ValueError(
+                        f"genesis: invalid BLS proof-of-possession for "
+                        f"validator {gv.name or gv.pub_key_bytes.hex()[:16]}"
+                    )
         return ValidatorSet(
             [
                 Validator.from_pub_key(_genesis_pub_key(gv), gv.power)
@@ -98,6 +132,7 @@ class GenesisDoc:
                     "pub_key_type": gv.pub_key_type,
                     "power": gv.power,
                     "name": gv.name,
+                    **({"pop": gv.pop.hex()} if gv.pop else {}),
                 }
                 for gv in self.validators
             ],
@@ -140,6 +175,7 @@ class GenesisDoc:
                     v["power"],
                     v.get("name", ""),
                     v.get("pub_key_type", "tendermint/PubKeyEd25519"),
+                    bytes.fromhex(v.get("pop", "")),
                 )
                 for v in d.get("validators", [])
             ],

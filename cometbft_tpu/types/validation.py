@@ -27,11 +27,14 @@ from .validator_set import ValidatorSet
 BATCH_VERIFY_THRESHOLD = 2
 
 _SECP_TAG = "tendermint/PubKeySecp256k1"
+_BLS_TAG = "tendermint/PubKeyBls12_381"
 
 
 def _curve_of(tag: str) -> str:
     """Metric/span curve label from a key type tag:
     "tendermint/PubKeyEd25519" -> "ed25519"."""
+    if tag == _BLS_TAG:
+        return "bls"
     return tag.rsplit("PubKey", 1)[-1].lower() or tag
 
 
@@ -150,12 +153,26 @@ def _verify_items(items, backend: str):
                                _time.perf_counter() - t0)
             deferred.append((idxs, verdicts))
         for tag, bv, idxs, t0, pending in in_flight:
+            pc0 = None
+            if tag == _BLS_TAG:
+                from ..crypto import bls as _bls
+
+                pc0 = _bls.pairing_checks()
             if pending is not None:
                 ok, bits = pending.result()
             else:
                 ok, bits = bv.verify()
-            _observe_partition(tag, "batch", len(idxs),
-                               _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            if pc0 is not None:
+                # the whole BLS partition collapsed into aggregate
+                # pairing check(s): 1 on accept, +n rescan on blame
+                if _trace.enabled:
+                    _trace.emit("crypto.bls_aggregate", "span",
+                                dur_ms=round(dt * 1e3, 3), n=len(idxs),
+                                pairing_checks=_bls.pairing_checks() - pc0)
+                _observe_partition(tag, "aggregate", len(idxs), dt)
+            else:
+                _observe_partition(tag, "batch", len(idxs), dt)
             if ok:
                 continue
             if bits:

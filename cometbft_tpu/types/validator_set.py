@@ -34,7 +34,9 @@ def _trunc_div(a: int, b: int) -> int:
 
 
 def encode_pub_key(pk: PubKey) -> bytes:
-    """crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2.
+    """crypto.v1.PublicKey oneof: ed25519=1, secp256k1=2, bls12_381=3
+    (48-byte min-pubkey-size compressed G1, matching CometBFT v1's
+    keys.proto addition).
 
     sr25519 deliberately has no proto representation, matching the
     reference codec (crypto/encoding/codec.go:44-50; keys.proto:15-16)."""
@@ -43,6 +45,8 @@ def encode_pub_key(pk: PubKey) -> bytes:
         return pb.f_bytes(1, pk.bytes(), emit_empty=True)
     if "Secp256k1" in tag:
         return pb.f_bytes(2, pk.bytes(), emit_empty=True)
+    if "Bls12_381" in tag:
+        return pb.f_bytes(3, pk.bytes(), emit_empty=True)
     raise ValueError(f"unsupported key type {tag}")
 
 
@@ -55,6 +59,10 @@ def decode_pub_key(fields: dict) -> PubKey:
         return Ed25519PubKey(bytes(fields[1]))
     if 2 in fields:
         return Secp256k1PubKey(bytes(fields[2]))
+    if 3 in fields:
+        from ..crypto.bls import BlsPubKey
+
+        return BlsPubKey(bytes(fields[3]))
     raise ValueError("unknown public key oneof")
 
 

@@ -268,6 +268,7 @@ SPAN_REGISTRY = {
     "blocksync.block": "one fast-synced block: fetch→verify→apply breakdown",
     "crypto.batch_verify": "one batch-verify dispatch: path, n, modeled host/wire/device terms",
     "crypto.commit_partition": "per-curve share of one commit verification",
+    "crypto.bls_aggregate": "one BLS partition collapsed to aggregate pairing check(s) (n/pairing_checks)",
     "crypto.mesh_submit": "one sharded mega-batch across the verify mesh (n/b/n_devices/shard_lanes)",
     "crypto.stream_place": "one streamed commit placed on a mesh device (device/n/b)",
     "mempool.admit_window": "one micro-batched admission window: n/dup/sig_fail/app_fail/admitted + stage ms",

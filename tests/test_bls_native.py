@@ -1,0 +1,456 @@
+"""BLS12-381 aggregate-signature track: native-vs-oracle differential
+conformance (accept AND reject), RFC 9380 hash-to-curve vectors, the
+one-pairing-check commit dispatch, and the compact aggregate-commit
+certificate."""
+
+import dataclasses
+import random
+from unittest import mock
+
+import pytest
+
+from cometbft_tpu.crypto import bls, native
+from cometbft_tpu.crypto.batch import (
+    create_batch_verifier,
+    supports_batch_verifier,
+)
+from cometbft_tpu.types.agg_commit import AggCommitError, AggregateCommit
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+from cometbft_tpu.types.block import BlockIDFlag, Commit, CommitSig
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.validation import ErrInvalidSignature, verify_commit
+from cometbft_tpu.types.vote import SignedMsgType, canonical_vote_bytes
+
+DST = bls.DST_SIG
+HAVE_NATIVE = native.bls_available()
+
+
+def oracle_only():
+    """Force every bls.* call through the pure-Python oracle."""
+    return mock.patch.object(native, "bls_available", lambda: False)
+
+
+def _sk(i: int) -> bls.BlsPrivKey:
+    return bls.BlsPrivKey.from_secret(b"bls-test-%d" % i)
+
+
+@pytest.fixture(scope="module")
+def keyring():
+    """(privs, pubs48, sigs96 over MSG) shared across the module — BLS
+    oracle signing costs real milliseconds, so amortize."""
+    privs = [_sk(i) for i in range(8)]
+    pubs = [k.pub_key().bytes() for k in privs]
+    sigs = [k.sign(MSG) for k in privs]
+    return privs, pubs, sigs
+
+
+MSG = b"tier1-bls-commit-msg"
+
+
+# ------------------------------------------------------- RFC 9380 H2C --
+# Compressed hash_to_curve outputs for the RFC 9380 appendix-H
+# BLS12381G2_XMD:SHA-256_SSWU_RO_ suite (x AND y verified against the
+# appendix's affine coordinates when the oracle was derived).
+_RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+_RFC_VECTORS = {
+    b"": (
+        "a5cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff"
+        "5bf5dd71b72418717047f5b0f37da03d0141ebfbdca40eb85b87142e130ab689"
+        "c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a"
+    ),
+    b"abc": (
+        "939cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4"
+        "ca3a230ed250fbe3a2acf73a41177fd802c2d18e033b960562aae3cab37a27ce"
+        "00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6"
+    ),
+    b"abcdef0123456789": (
+        "990d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169fb396"
+        "8288b3fafb265f9ebd380512a71c3f2c121982811d2491fde9ba7ed31ef9ca47"
+        "4f0e1501297f68c298e9f4c0028add35aea8bb83d53c08cfc007c1e005723cd0"
+    ),
+}
+
+
+def test_rfc9380_hash_to_g2_vectors_oracle():
+    for msg, want in _RFC_VECTORS.items():
+        with oracle_only():
+            got = bls.hash_to_g2_compressed(msg, _RFC_DST)
+        assert got.hex() == want, msg
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native BLS engine not built")
+def test_rfc9380_hash_to_g2_vectors_native():
+    for msg, want in _RFC_VECTORS.items():
+        assert native.bls_hash_to_g2(msg, _RFC_DST).hex() == want, msg
+
+
+# ------------------------------------------- native/oracle differential --
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native BLS engine not built")
+def test_native_sign_pubkey_bit_agreement(keyring):
+    privs, pubs, sigs = keyring
+    for i, k in enumerate(privs[:4]):
+        assert native.bls_pubkey(k.bytes()) == pubs[i]
+        assert native.bls_sign(k.bytes(), MSG, DST) == sigs[i]
+        with oracle_only():
+            assert bls.sign_python(k._d, MSG, DST) == sigs[i]
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native BLS engine not built")
+def test_native_verify_accept_and_reject(keyring):
+    privs, pubs, sigs = keyring
+    assert native.bls_verify(pubs[0], MSG, sigs[0], DST) is True
+    with oracle_only():
+        assert bls.verify_one(pubs[0], MSG, sigs[0], DST) is True
+    # flipped message bit: both paths reject
+    flipped = bytes([MSG[0] ^ 1]) + MSG[1:]
+    assert native.bls_verify(pubs[0], flipped, sigs[0], DST) is False
+    with oracle_only():
+        assert bls.verify_one(pubs[0], flipped, sigs[0], DST) is False
+    # wrong key
+    assert native.bls_verify(pubs[1], MSG, sigs[0], DST) is False
+    # corrupted signature byte (may also fail decode — never verify)
+    bad = bytearray(sigs[0])
+    bad[40] ^= 0x10
+    assert native.bls_verify(pubs[0], MSG, bytes(bad), DST) is not True
+    with oracle_only():
+        assert bls.verify_one(pubs[0], MSG, bytes(bad), DST) is not True
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native BLS engine not built")
+def test_native_pairing_bytes_bit_agreement(keyring):
+    """The hardest surface: 576-byte post-final-exp GT serialization
+    must match the oracle bit-for-bit (pins Montgomery arithmetic, the
+    tower, the Jacobian Miller loop's scale-factor cancellation, and
+    the final exponentiation all at once)."""
+    _, pubs, _ = keyring
+    for m in (b"gt-1", b"gt-2"):
+        q96 = bls.hash_to_g2_compressed(m, DST)
+        with oracle_only():
+            want = bls.pairing_bytes(pubs[0], q96)
+        assert native.bls_pairing(pubs[0], q96) == want
+
+
+def _non_subgroup_g2_point():
+    """An on-twist point outside the r-order subgroup, found by x-search
+    (the twist's cofactor is astronomically larger than r, so any random
+    on-curve point is non-subgroup)."""
+    x0 = 9000
+    while True:
+        x0 += 1
+        cand = (x0, 3 * x0 + 1)
+        rhs = bls._f2add(bls._f2mul(bls._f2sqr(cand), cand), bls._B2)
+        y = bls._f2sqrt(rhs)
+        if y is None:
+            continue
+        if not bls.g2_subgroup_check((cand, y)):
+            return (cand, y)
+
+
+def test_reject_non_canonical_and_bad_subgroup(keyring):
+    _, pubs, sigs = keyring
+    # compression flag missing
+    no_flag = bytes([pubs[0][0] & 0x7F]) + pubs[0][1:]
+    assert bls.g1_decompress(no_flag) is None
+    # infinity with stray payload bits
+    assert bls.g1_decompress(b"\xc0" + b"\x01" + b"\x00" * 46) is None
+    assert bls.g2_decompress(b"\xc0" + b"\x00" * 94 + b"\x01") is None
+    # x coordinate >= p is non-canonical
+    too_big = bytes([0x9f]) + b"\xff" * 47
+    assert bls.g1_decompress(too_big) is None
+    # on-curve but non-subgroup G2 point: decompresses, fails the
+    # subgroup gate on both paths
+    pt = _non_subgroup_g2_point()
+    enc = bls.g2_compress(pt)
+    assert bls.g2_decompress(enc) is not None
+    assert not bls.g2_subgroup_check(pt)
+    if HAVE_NATIVE:
+        assert native.bls_g2_decompress(enc) == pt
+        assert native.bls_g2_subgroup_check(enc) == 0
+        assert native.bls_g2_subgroup_check(bls.g2_compress(
+            bls.hash_to_g2(b"in-subgroup", DST))) == 1
+        assert native.bls_g1_subgroup_check(pubs[0]) == 1
+        # a valid signature is a valid G2 subgroup member
+        assert native.bls_g2_subgroup_check(sigs[0]) == 1
+
+
+def test_identity_pubkey_rejected():
+    inf48 = b"\xc0" + b"\x00" * 47
+    assert bls._pubkey_point(inf48) is None
+    assert bls.aggregate_pubkeys([inf48]) is None
+    if HAVE_NATIVE:
+        assert native.bls_aggregate_pubkeys(inf48, 1, b"\x01", 0) is None
+
+
+def test_plus_minus_identity_aggregate_rejected(keyring):
+    """P and -P aggregate to infinity — the degenerate apk any PoP-less
+    rogue-key attack lands on. Both paths must refuse it."""
+    _, pubs, _ = keyring
+    x, y = bls.g1_decompress(pubs[0])
+    neg = bls.g1_compress((x, bls.P - y))
+    assert bls.aggregate_pubkeys([pubs[0], neg]) is None
+    if HAVE_NATIVE:
+        assert native.bls_aggregate_pubkeys(
+            pubs[0] + neg, 2, b"\x03", 0) is None
+
+
+def test_aggregate_chunk_determinism(keyring):
+    """nchunks only partitions work; results are byte-identical across
+    chunk counts and between engines."""
+    _, pubs, sigs = keyring
+    n = len(sigs)
+    blob_s, blob_p = b"".join(sigs), b"".join(pubs)
+    bitmap = bytes([0b11011011])  # drop validators 2 and 5
+    with oracle_only():
+        want_sig = bls.aggregate_signatures(sigs)
+        want_apk = bls.aggregate_pubkeys(pubs, bitmap)
+    for nc in (0, 1, 3, 8):
+        assert bls.aggregate_signatures(sigs, nchunks=nc) == want_sig
+        assert bls.aggregate_pubkeys(pubs, bitmap, nchunks=nc) == want_apk
+        if HAVE_NATIVE:
+            assert native.bls_aggregate_sigs(blob_s, n, nc) == want_sig
+            assert native.bls_aggregate_pubkeys(
+                blob_p, n, bitmap, nc) == want_apk
+
+
+def test_aggregate_verify_accept_reject_differential(keyring):
+    privs, pubs, sigs = keyring
+    n = len(privs)
+    items_same = [(pubs[i], MSG, sigs[i]) for i in range(n)]
+    msgs = [b"distinct-%d" % i for i in range(n)]
+    sigs2 = [privs[i].sign(msgs[i]) for i in range(n)]
+    items_multi = [(pubs[i], msgs[i], sigs2[i]) for i in range(n)]
+    # one sig over the wrong message, one by the wrong key
+    bad_msg = list(items_multi)
+    bad_msg[3] = (pubs[3], msgs[3], privs[3].sign(b"not-msg-3"))
+    bad_key = list(items_multi)
+    bad_key[5] = (pubs[5], msgs[5], privs[6].sign(msgs[5]))
+    for items, want in ((items_same, True), (items_multi, True),
+                        (bad_msg, False), (bad_key, False)):
+        assert bls.aggregate_verify_items(items) is want
+        with oracle_only():
+            assert bls.aggregate_verify_items(items) is want
+
+
+def test_sign_verify_fuzz_differential(keyring):
+    """Randomized accept/reject sweep; native and oracle must agree on
+    every verdict, including mutated inputs."""
+    privs, pubs, sigs = keyring
+    rng = random.Random(0xB15)
+    for trial in range(10):
+        i = rng.randrange(len(privs))
+        msg = rng.randbytes(rng.randrange(1, 64))
+        sig = privs[i].sign(msg)
+        mutate = rng.randrange(3)
+        if mutate == 1:
+            pos = rng.randrange(len(msg))
+            msg = (msg[:pos] + bytes([msg[pos] ^ (1 << rng.randrange(8))])
+                   + msg[pos + 1:])
+        elif mutate == 2:
+            pos = rng.randrange(96)
+            sig = (sig[:pos] + bytes([sig[pos] ^ (1 << rng.randrange(8))])
+                   + sig[pos + 1:])
+        with oracle_only():
+            want = bls.verify_one(pubs[i], msg, sig)
+        got = bls.verify_one(pubs[i], msg, sig)
+        assert got is want, (trial, mutate)
+        if mutate == 0:
+            assert want is True
+
+
+# ------------------------------------------------- batch verifier seam --
+def test_batch_verifier_seam_and_blame_bitmap(keyring):
+    privs, pubs, sigs = keyring
+    pk = privs[0].pub_key()
+    assert supports_batch_verifier(pk)
+    bv = create_batch_verifier(pk, backend="cpu")
+    assert isinstance(bv, bls.BlsBatchVerifier)
+    for i in (0, 1, 2, 3):
+        sig = sigs[i]
+        if i == 2:
+            sig = sigs[3]  # wrong slot: invalid
+        assert bv.add(privs[i].pub_key(), MSG, sig)
+    ok, bits = bv.verify()
+    assert not ok
+    assert bits == [True, True, False, True]
+
+
+# ------------------------------------------- one-pairing-check dispatch --
+def _bls_fixture(n, power=10):
+    privs = [_sk(100 + i) for i in range(n)]
+    gvs = [GenesisValidator(k.pub_key().bytes(), power, "v%d" % i,
+                            bls.KEY_TYPE, k.pop())
+           for i, k in enumerate(privs)]
+    vals = GenesisDoc(chain_id="bls-t", validators=gvs).validator_set()
+    by_addr = {k.pub_key().address(): k for k in privs}
+    return vals, by_addr
+
+
+def _commit_over(vals, by_addr, chain_id="bls-t", height=5, skip=()):
+    bid = BlockID(b"\x42" * 32, PartSetHeader(1, b"\x43" * 32))
+    ts = Timestamp(1_700_000_000, 0)
+    msg = canonical_vote_bytes(
+        SignedMsgType.PRECOMMIT, height, 0, bid, ts, chain_id)
+    commit = Commit(height, 0, bid, [])
+    for i in range(len(vals)):
+        v = vals.get_by_index(i)
+        if i in skip:
+            commit.signatures.append(CommitSig.absent())
+            continue
+        commit.signatures.append(CommitSig(
+            BlockIDFlag.COMMIT, v.address, ts, by_addr[v.address].sign(msg)))
+    return commit, bid
+
+
+def test_all_bls_commit_is_one_pairing_check():
+    """VerifyCommit over an all-BLS commit collapses the whole signature
+    column into exactly ONE pairing-product evaluation."""
+    vals, by_addr = _bls_fixture(6)
+    commit, bid = _commit_over(vals, by_addr)
+    pc0 = bls.pairing_checks()
+    verify_commit("bls-t", vals, bid, 5, commit, backend="cpu")
+    assert bls.pairing_checks() - pc0 == 1
+
+
+def test_all_bls_commit_bad_sig_blamed():
+    vals, by_addr = _bls_fixture(5)
+    commit, bid = _commit_over(vals, by_addr)
+    good = commit.signatures[2]
+    commit.signatures[2] = CommitSig(
+        good.block_id_flag, good.validator_address, good.timestamp,
+        commit.signatures[3].signature)
+    with pytest.raises(ErrInvalidSignature, match="index 2"):
+        verify_commit("bls-t", vals, bid, 5, commit, backend="cpu")
+
+
+def test_mixed_curve_commit_partitions():
+    """ed25519 + BLS validators in one commit: per-curve partition
+    dispatch — the BLS side still collapses to one pairing check."""
+    from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    bls_privs = [_sk(200 + i) for i in range(3)]
+    ed_privs = [Ed25519PrivKey.generate() for _ in range(3)]
+    vals = ValidatorSet([
+        Validator.from_pub_key(k.pub_key(), 10)
+        for k in (*bls_privs, *ed_privs)
+    ])
+    by_addr = {k.pub_key().address(): k for k in (*bls_privs, *ed_privs)}
+    commit, bid = _commit_over(vals, by_addr, chain_id="mix")
+    pc0 = bls.pairing_checks()
+    verify_commit("mix", vals, bid, 5, commit, backend="cpu")
+    assert bls.pairing_checks() - pc0 == 1
+
+
+# ------------------------------------------------ aggregate certificate --
+def test_agg_commit_roundtrip_and_verify():
+    vals, by_addr = _bls_fixture(7)
+    commit, bid = _commit_over(vals, by_addr, skip=(4,))
+    cert = AggregateCommit.from_commit(commit)
+    assert cert.signer_count() == 6
+    cert2 = AggregateCommit.decode(cert.encode())
+    assert cert2 == cert
+    pc0 = bls.pairing_checks()
+    cert2.verify("bls-t", vals)
+    assert bls.pairing_checks() - pc0 == 1
+    # compact: bitmap + one 96B signature, not 6 * 96B
+    assert cert.wire_size() < 220
+
+
+def test_agg_commit_rejects():
+    vals, by_addr = _bls_fixture(6)
+    commit, bid = _commit_over(vals, by_addr)
+    cert = AggregateCommit.from_commit(commit)
+    # tampered aggregate
+    bad = dataclasses.replace(
+        cert, agg_sig=cert.agg_sig[:-1]
+        + bytes([cert.agg_sig[-1] ^ 1]))
+    with pytest.raises(AggCommitError):
+        bad.verify("bls-t", vals)
+    # wrong chain id changes the canonical message
+    with pytest.raises(AggCommitError, match="invalid"):
+        cert.verify("other-chain", vals)
+    # sub-threshold bitmap (claims fewer signers than 2/3)
+    thin = dataclasses.replace(cert, bitmap=b"\x03")
+    with pytest.raises(AggCommitError, match="threshold"):
+        thin.verify("bls-t", vals)
+    # phantom bits beyond the validator set
+    phantom = dataclasses.replace(cert, bitmap=b"\xff")
+    with pytest.raises(AggCommitError, match="beyond"):
+        phantom.verify("bls-t", vals)
+    # non-uniform timestamps cannot fold
+    commit.signatures[1] = dataclasses.replace(
+        commit.signatures[1], timestamp=Timestamp(1_700_000_001, 0))
+    with pytest.raises(AggCommitError, match="uniform"):
+        AggregateCommit.from_commit(commit)
+
+
+# --------------------------------------------------- genesis & privval --
+def test_genesis_key_size_table():
+    ed = GenesisValidator(b"\x01" * 32, 1)
+    secp = GenesisValidator(b"\x02" * 33, 1,
+                            pub_key_type="tendermint/PubKeySecp256k1")
+    GenesisDoc(chain_id="t", validators=[ed, secp]).validate_basic()
+    # wrong sizes rejected per exact type (the old substring check
+    # measured every non-secp type against 32)
+    with pytest.raises(ValueError, match="pubkey size"):
+        GenesisDoc(chain_id="t", validators=[
+            GenesisValidator(b"\x01" * 33, 1)]).validate_basic()
+    with pytest.raises(ValueError, match="pubkey size"):
+        GenesisDoc(chain_id="t", validators=[
+            GenesisValidator(b"\x01" * 32, 1,
+                             pub_key_type=bls.KEY_TYPE,
+                             pop=b"\x01" * 96)]).validate_basic()
+    with pytest.raises(ValueError, match="not supported"):
+        GenesisDoc(chain_id="t", validators=[
+            GenesisValidator(b"\x01" * 32, 1,
+                             pub_key_type="tendermint/PubKeySr25519")
+        ]).validate_basic()
+
+
+def test_genesis_bls_pop_required_and_checked():
+    k = _sk(300)
+    pub = k.pub_key().bytes()
+    with pytest.raises(ValueError, match="proof-of-possession"):
+        GenesisDoc(chain_id="t", validators=[
+            GenesisValidator(pub, 1, pub_key_type=bls.KEY_TYPE)
+        ]).validate_basic()
+    wrong_pop = _sk(301).pop()
+    gd = GenesisDoc(chain_id="t", validators=[
+        GenesisValidator(pub, 1, pub_key_type=bls.KEY_TYPE,
+                         pop=wrong_pop)])
+    gd.validate_basic()  # shape is fine
+    with pytest.raises(ValueError, match="proof-of-possession"):
+        gd.validator_set()  # crypto gate fires at construction
+    good = GenesisDoc(chain_id="t", validators=[
+        GenesisValidator(pub, 1, pub_key_type=bls.KEY_TYPE, pop=k.pop())])
+    assert len(GenesisDoc.from_json(good.to_json()).validator_set()) == 1
+
+
+def test_proto_pubkey_oneof_bls():
+    from cometbft_tpu.encoding import proto as pb
+    from cometbft_tpu.types.validator_set import (
+        decode_pub_key,
+        encode_pub_key,
+    )
+
+    pk = _sk(310).pub_key()
+    enc = encode_pub_key(pk)
+    assert decode_pub_key(pb.fields_to_dict(enc)) == pk
+
+
+def test_privval_bls_signing(tmp_path):
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.vote import Vote
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp, key_type=bls.KEY_TYPE)
+    assert pv.pub_key().type_tag() == bls.KEY_TYPE
+    pv2 = FilePV.load(kp, sp)  # key_type survives the key file
+    assert pv2.pub_key() == pv.pub_key()
+    vote = Vote(type=SignedMsgType.PRECOMMIT, height=3, round=0,
+                block_id=BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32)),
+                timestamp=Timestamp(1, 0),
+                validator_address=pv.address(), validator_index=0)
+    pv2.sign_vote("pv-chain", vote)
+    assert pv.pub_key().verify_signature(
+        vote.sign_bytes("pv-chain"), vote.signature)

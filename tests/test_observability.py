@@ -538,3 +538,20 @@ def test_bench_compare_advisory_never_gates():
     )
     assert p.returncode == 0, p.stderr
     assert "bench_compare:" in p.stdout
+
+
+def test_bench_compare_bls_advisory_never_gates():
+    """tools/bench_compare.py --bls --advisory: the ed25519-vs-BLS
+    crossover diff is informational in tier-1 — rc 0 whether the
+    WORKLOADS.json record exists on both sides, one side, or regressed
+    — and the crossover line always renders."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_compare.py"),
+         "--bls", "--advisory", "--threshold", "0.001"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    assert "bls crossover" in p.stdout
+    assert "bench_compare:" in p.stdout

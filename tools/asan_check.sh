@@ -5,9 +5,11 @@
 # packer entry points (rlc_pack / rlc_packer_threads) with tight
 # buffers: n==0, all-skip, max-bucket, and chunk-determinism shapes —
 # plus the secp256k1 verify engine (r/s boundary values, bad point
-# encodings, multi-verify chunk determinism) and the sr25519 unit
+# encodings, multi-verify chunk determinism), the sr25519 unit
 # (ristretto decode rejects, merlin challenge, batch residue s >= L,
-# n==0 batches).
+# n==0 batches), and the BLS12-381 pairing engine (PoP cycle,
+# identity-point rejection, n==0 aggregates, 128-key max-size
+# aggregation chunk determinism, single cert pairing check).
 set -e
 cd "$(dirname "$0")/.."
 # -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults

@@ -40,7 +40,7 @@ DEFAULT_FILES = ("WORKLOADS.json", "BENCH_r05.json")
 
 _HIGHER = ("per_sec", "per_s", "throughput", "speedup", "improvement",
            "per_app_call", "per_core", "headers_per", "txs_per",
-           "sigs_per", "blocks_per")
+           "sigs_per", "blocks_per", "bytes_ratio")
 _LOWER = ("_ms", "ms.", "latency", "p50", "p99", "seconds", "elapsed",
           "overhead", "degradation", "wait", "relative_error",
           "sink_bytes", "duration")
@@ -238,6 +238,101 @@ def compare_ingest(ref: str, threshold: float,
     }
 
 
+def _bls_record(flat_src: str):
+    """The megacommit_bls_* record (dict) from a WORKLOADS.json body, or
+    None. Matched by prefix so a size change (500v quick vs 10000v full)
+    still finds the record."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        for key, rec in data.items():
+            if key.startswith("megacommit_bls_") and isinstance(rec, dict):
+                return rec
+    return None
+
+
+def compare_bls(ref: str, threshold: float,
+                relpath: str = "WORKLOADS.json") -> dict:
+    """Point-by-point diff of the ed25519-vs-BLS crossover table
+    (ISSUE 13). Latency keys (*_ms) are lower-better, byte ratios and
+    speedups higher-better — the shared direction machinery decides, so
+    a renamed key can never silently flip polarity. The crossover point
+    itself is first-class: it moving UP (BLS winning later) is the
+    regression the aggregate track exists to prevent."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _bls_record(f.read())
+    base = _bls_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no megacommit_bls record on one side"}
+
+    rows = []
+    b_pts = base.get("points") or {}
+    c_pts = cur.get("points") or {}
+    for n in sorted(c_pts, key=int):
+        if n not in b_pts:
+            continue
+        for key in c_pts[n]:
+            b, c = b_pts[n].get(key), c_pts[n].get(key)
+            if not isinstance(b, (int, float)) or b == 0 \
+                    or not isinstance(c, (int, float)) \
+                    or isinstance(b, bool) or isinstance(c, bool):
+                continue
+            d = direction(key)
+            if d == "neutral":
+                continue
+            rel = (c - b) / abs(b)
+            rows.append({
+                "point": f"{n}v", "key": key, "baseline": b, "current": c,
+                "change_pct": round(rel * 100, 1), "direction": d,
+                "worse": (rel > threshold if d == "lower"
+                          else rel < -threshold),
+                "better": (rel < -threshold if d == "lower"
+                           else rel > threshold),
+            })
+    b_x, c_x = base.get("crossover_validators"), cur.get("crossover_validators")
+    crossover = {"baseline": b_x, "current": c_x,
+                 # None = never crossed: treat as +inf so gaining a
+                 # crossover is an improvement, losing one a regression
+                 "worse": (b_x is not None
+                           and (c_x is None or c_x > b_x)),
+                 "better": (c_x is not None
+                            and (b_x is None or c_x < b_x))}
+    regs = [r for r in rows if r["worse"]]
+    if crossover["worse"]:
+        regs.append({"key": "crossover_validators", **crossover})
+    return {
+        "file": relpath, "mode": "bls_crossover",
+        "crossover": crossover,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_bls(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"bls crossover: skipped ({rep['skipped']})")
+        return
+    x = rep["crossover"]
+    tag = ("REGRESSION" if x["worse"]
+           else "improved  " if x["better"] else "          ")
+    print(f"bls crossover ({rep['file']}): {tag} cert beats ed25519 from "
+          f"{x['baseline']} -> {x['current']} validators")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-7s %-24s %10g -> %-10g (%+.1f%%, %s-better)"
+              % (tag, r["point"], r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_ingest(rep: dict) -> None:
     if "skipped" in rep:
         print(f"ingest waterfall: skipped ({rep['skipped']})")
@@ -268,6 +363,10 @@ def main(argv=None) -> int:
                     help="also diff the sustained-ingest stage waterfall "
                          "stage-by-stage (proposal_wait / commit p99 "
                          "first-class)")
+    ap.add_argument("--bls", action="store_true",
+                    help="also diff the ed25519-vs-BLS crossover table "
+                         "point-by-point (the crossover validator count "
+                         "first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -283,14 +382,19 @@ def main(argv=None) -> int:
                for f in args.files]
     ingest_rep = (compare_ingest(args.ref, args.threshold)
                   if args.ingest else None)
+    bls_rep = (compare_bls(args.ref, args.threshold)
+               if args.bls else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    if ingest_rep is not None:
-        n_reg += len(ingest_rep.get("regressions", ()))
+    for extra in (ingest_rep, bls_rep):
+        if extra is not None:
+            n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
                "advisory": args.advisory, "total_regressions": n_reg,
                "files": reports}
     if ingest_rep is not None:
         summary["ingest_waterfall"] = ingest_rep
+    if bls_rep is not None:
+        summary["bls_crossover"] = bls_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -312,6 +416,8 @@ def main(argv=None) -> int:
                          row["change_pct"]))
         if ingest_rep is not None:
             _print_ingest(ingest_rep)
+        if bls_rep is not None:
+            _print_bls(bls_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "
