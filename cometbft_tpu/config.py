@@ -264,6 +264,45 @@ class LightConfig:
 
 
 @dataclass
+class DAConfig:
+    """Data-availability sampling (da/, ROADMAP #3).
+
+    When `enabled`, every committed block's payload is split into
+    `data_shards` chunks, extended with `parity_shards` Reed-Solomon
+    parity chunks over GF(2^16), and committed to in the header's
+    da_root. The node serves per-chunk opening proofs on da_sample and
+    advertises the commitment on /light_stream; sampling clients
+    (da/sampler.py) reach `confidence` that at least half the extended
+    chunks — enough to reconstruct — are available."""
+
+    enabled: bool = False
+    data_shards: int = 16
+    parity_shards: int = 16
+    # samples each client draws per block; 0 derives the count from
+    # `confidence` (da/sampler.py samples_for_confidence)
+    samples_per_client: int = 0
+    confidence: float = 0.99
+    # extended-shard sets kept resident for serving samples
+    retain_heights: int = 64
+
+    def validate(self) -> None:
+        from .da.rs import MAX_SHARDS
+
+        if self.data_shards < 1 or self.parity_shards < 1:
+            raise ValueError("da shard counts must be >= 1")
+        if self.data_shards + self.parity_shards > MAX_SHARDS:
+            raise ValueError(
+                f"da.data_shards + da.parity_shards must be <= {MAX_SHARDS}"
+            )
+        if self.samples_per_client < 0:
+            raise ValueError("da.samples_per_client must be >= 0")
+        if not (0.0 < self.confidence < 1.0):
+            raise ValueError("da.confidence must be in (0, 1)")
+        if self.retain_heights < 1:
+            raise ValueError("da.retain_heights must be >= 1")
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -311,6 +350,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     light: LightConfig = field(default_factory=LightConfig)
+    da: DAConfig = field(default_factory=DAConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
@@ -318,7 +358,7 @@ class Config:
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
-                        self.light, self.instrumentation):
+                        self.light, self.da, self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------
@@ -359,6 +399,7 @@ class Config:
             emit("statesync", self.statesync),
             emit("storage", self.storage),
             emit("light", self.light),
+            emit("da", self.da),
             emit("instrumentation", self.instrumentation),
         ]
         return "\n\n".join(parts) + "\n"
@@ -397,6 +438,7 @@ class Config:
             statesync=mk(StateSyncConfig, d.get("statesync", {})),
             storage=mk(StorageConfig, d.get("storage", {})),
             light=mk(LightConfig, d.get("light", {})),
+            da=mk(DAConfig, d.get("da", {})),
             instrumentation=mk(InstrumentationConfig,
                                d.get("instrumentation", {})),
         )

@@ -169,6 +169,12 @@ class ConsensusState:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self._step_cv = threading.Condition()
+        # round-state snapshot lock: the consensus thread holds it across
+        # each _process (one message/timeout = one atomic round-state
+        # transition), so RPC dump routes can take a CONSISTENT snapshot
+        # by acquiring it instead of retry-sampling racy fields. RLock:
+        # handlers re-enter _process-held paths via the WAL replay seam.
+        self.rs_mutex = threading.RLock()
 
         # --- RoundState ---
         self.height = sm_state.last_block_height + 1
@@ -299,7 +305,8 @@ class ConsensusState:
     def _process(self, item) -> None:
         before = (self.height, self.round, int(self.step))
         try:
-            self._process_inner(item)
+            with self.rs_mutex:
+                self._process_inner(item)
         finally:
             if self.on_new_step is not None and (
                 (self.height, self.round, int(self.step)) != before

@@ -33,6 +33,7 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "secp256k1.inc"),
     os.path.join(os.path.dirname(_SRC), "sr25519_native.inc"),
     os.path.join(os.path.dirname(_SRC), "bls12_381.inc"),
+    os.path.join(os.path.dirname(_SRC), "rs_gf16.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -250,6 +251,19 @@ def _bind(lib) -> None:
         ctypes.c_char_p, ctypes.c_uint64,                   # msg
         ctypes.c_char_p,                                    # agg sig
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,     # dst, nchunks
+    ]
+    lib.rs_gf16_threads.restype = ctypes.c_int
+    lib.rs_gf16_threads.argtypes = []
+    lib.rs_encode16.restype = ctypes.c_long
+    lib.rs_encode16.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,  # shard_len, k, m
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,     # data, parity, nchunks
+    ]
+    lib.rs_reconstruct16.restype = ctypes.c_long
+    lib.rs_reconstruct16.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,  # shard_len, k, m
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,  # shards, present, out
+        ctypes.c_int,                                       # nchunks
     ]
     lib.commit_parse.restype = ctypes.c_long
     lib.commit_parse.argtypes = [
@@ -851,6 +865,67 @@ def bls_cert_verify(pubs_blob: bytes, n: int, bitmap: bytes,
     return bool(lib.bls_cert_verify(
         n, pubs_blob, bitmap, msg, len(msg), agg_sig,
         dst, len(dst), nchunks))
+
+
+def rs_available() -> bool:
+    """True when the .so exports the GF(2^16) Reed-Solomon codec."""
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "rs_encode16")
+
+
+def rs_threads() -> int:
+    """Worker count the RS codec spreads a shard set across (1 when the
+    lib is absent — the numpy oracle is single-core anyway)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rs_gf16_threads"):
+        return 1
+    return max(1, int(lib.rs_gf16_threads()))
+
+
+def rs_encode(data_blob, k: int, m: int, shard_len: int,
+              nchunks: int = 0) -> bytes | None:
+    """m parity shards from `data_blob` (k*shard_len bytes, any
+    buffer-protocol object — passed zero-copy) as one m*shard_len
+    bytes string. None when the lib is absent or the engine declines
+    the parameters (caller uses the numpy oracle)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rs_encode16"):
+        return None
+    import numpy as _np
+
+    parity = _np.empty(m * shard_len, _np.uint8)
+    rc = lib.rs_encode16(
+        shard_len, k, m,
+        _np.frombuffer(data_blob, _np.uint8).ctypes.data_as(ctypes.c_void_p),
+        parity.ctypes.data_as(ctypes.c_void_p), nchunks,
+    )
+    if rc != 0:
+        return None
+    return parity.tobytes()
+
+
+def rs_reconstruct(shards_blob, present: bytes, k: int, m: int,
+                   shard_len: int, nchunks: int = 0) -> bytes | None:
+    """All n = k+m shards reconstructed from the survivors flagged in
+    `present` (n 0/1 bytes; missing rows of `shards_blob` are ignored).
+    Returns the full n*shard_len buffer, or None when the lib is
+    absent / parameters are declined / fewer than k shards survive —
+    the caller's oracle path reproduces the exact error."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rs_reconstruct16"):
+        return None
+    import numpy as _np
+
+    out = _np.empty((k + m) * shard_len, _np.uint8)
+    rc = lib.rs_reconstruct16(
+        shard_len, k, m,
+        _np.frombuffer(shards_blob, _np.uint8).ctypes.data_as(
+            ctypes.c_void_p),
+        present, out.ctypes.data_as(ctypes.c_void_p), nchunks,
+    )
+    if rc != 0:
+        return None
+    return out.tobytes()
 
 
 def sr25519_ristretto_decode(enc: bytes):

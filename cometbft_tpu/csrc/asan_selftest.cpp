@@ -77,6 +77,11 @@ int bls_aggregate_pubkeys(u64 n, const u8 *blob, const u8 *bitmap,
 int bls_cert_verify(u64 n, const u8 *pubs, const u8 *bitmap, const u8 *msg,
                     u64 mlen, const u8 *agg_sig96, const u8 *dst, u64 dlen,
                     int nchunks);
+int rs_gf16_threads(void);
+long rs_encode16(u64 shard_len, uint32_t k, uint32_t m, const u8 *data,
+                 u8 *parity_out, int nchunks);
+long rs_reconstruct16(u64 shard_len, uint32_t k, uint32_t m, const u8 *shards,
+                      const u8 *present, u8 *out, int nchunks);
 }
 
 // deterministic PRNG for the fuzz loops (no OS entropy in the harness)
@@ -553,6 +558,96 @@ static int rlc_packer_checks() {
     return 0;
 }
 
+// -- GF(2^16) Reed-Solomon codec surface ----------------------------------
+//
+// The DA erasure codec (rs_gf16.inc) runs shard-parallel across the
+// worker pool, so ASAN must see the threaded apply_rows phase with
+// tight heap buffers: parameter guards, the 4096-shard ceiling edge
+// (tiny shards keep it cheap), a full encode->erase-m->reconstruct
+// roundtrip, and the chunk-count determinism contract the da/ layer
+// relies on.
+
+static long rs_roundtrip(uint32_t k, uint32_t m, u64 shard_len, int nchunks,
+                         std::vector<u8> *out_full) {
+    u64 n = (u64)k + m;
+    std::vector<u8> data(k * shard_len), parity(m * shard_len);
+    for (auto &b : data) b = lcg();
+    long rc = rs_encode16(shard_len, k, m, data.data(), parity.data(),
+                          nchunks);
+    if (rc != 0) return rc;
+    std::vector<u8> full(n * shard_len);
+    memcpy(full.data(), data.data(), data.size());
+    memcpy(full.data() + data.size(), parity.data(), parity.size());
+    // erase the FIRST m shards: survivors include every parity row
+    std::vector<u8> present(n, 1), holes(full);
+    for (uint32_t i = 0; i < m; i++) {
+        present[i] = 0;
+        memset(&holes[(size_t)i * shard_len], 0xAB, shard_len);
+    }
+    std::vector<u8> rec(n * shard_len);
+    rc = rs_reconstruct16(shard_len, k, m, holes.data(), present.data(),
+                          rec.data(), nchunks);
+    if (rc != 0) return rc;
+    if (rec != full) return -99;
+    if (out_full) *out_full = std::move(rec);
+    return 0;
+}
+
+static int rs_checks() {
+    if (rs_gf16_threads() < 1) {
+        printf("FAIL: rs_gf16_threads < 1\n");
+        return 1;
+    }
+    u8 buf[8] = {0}, out[8];
+    u8 two_present[2] = {1, 1};
+    // parameter guards: k == 0, zero/odd shard length, shard ceiling
+    if (rs_encode16(2, 0, 1, buf, out, 0) != -1 ||
+        rs_encode16(0, 1, 1, buf, out, 0) != -1 ||
+        rs_encode16(3, 1, 1, buf, out, 0) != -1 ||
+        rs_encode16(2, 4000, 200, buf, out, 0) != -1 ||
+        rs_reconstruct16(2, 0, 1, buf, two_present, out, 0) != -1 ||
+        rs_reconstruct16(5, 1, 1, buf, two_present, out, 0) != -1) {
+        printf("FAIL: rs guard rcs\n");
+        return 1;
+    }
+    // fewer than k survivors: decline (-2), output untouched
+    {
+        uint32_t k = 4, m = 4;
+        std::vector<u8> shards(8 * 16, 0), rec(8 * 16);
+        u8 present[8] = {1, 1, 1, 0, 0, 0, 0, 0};
+        if (rs_reconstruct16(16, k, m, shards.data(), present, rec.data(),
+                             0) != -2) {
+            printf("FAIL: rs insufficient survivors != -2\n");
+            return 1;
+        }
+    }
+    // roundtrips: small, parity-heavy, and the 4096-shard ceiling edge
+    if (rs_roundtrip(1, 1, 2, 0, nullptr) != 0 ||
+        rs_roundtrip(3, 7, 34, 0, nullptr) != 0 ||
+        rs_roundtrip(16, 16, 256, 0, nullptr) != 0 ||
+        rs_roundtrip(2048, 2048, 2, 0, nullptr) != 0) {
+        printf("FAIL: rs roundtrips\n");
+        return 1;
+    }
+    // chunk-count determinism across the worker pool split
+    u64 seed_snapshot = lcg_state;
+    std::vector<u8> one, again;
+    if (rs_roundtrip(8, 8, 1000, 1, &one) != 0) {
+        printf("FAIL: rs chunked roundtrip\n");
+        return 1;
+    }
+    for (int nchunks : {2, 3, 7}) {
+        lcg_state = seed_snapshot;
+        if (rs_roundtrip(8, 8, 1000, nchunks, &again) != 0 || again != one) {
+            printf("FAIL: rs not chunk-count deterministic (%d)\n", nchunks);
+            return 1;
+        }
+    }
+    printf("asan rs gf(2^16) checks ok (guards, survivors, max shards, "
+           "chunk determinism)\n");
+    return 0;
+}
+
 // -- BLS12-381 pairing engine surface -------------------------------------
 //
 // Keys are derived natively (unlike secp/sr there IS a native signer),
@@ -752,6 +847,7 @@ int main() {
     if (rlc_packer_checks() != 0) return 1;
     if (secp256k1_checks() != 0) return 1;
     if (sr25519_checks() != 0) return 1;
+    if (rs_checks() != 0) return 1;
     if (bls_checks() != 0) return 1;
     printf("asan selftest ok (%d signatures, threaded batch)\n", N);
     return 0;

@@ -1354,3 +1354,8 @@ void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
 // BLS12-381 pairing engine — aggregate-signature track (own extern "C"
 // exports; uses sha256n from merkle_native.inc, pool from rlc_packer.inc)
 #include "bls12_381.inc"
+
+// GF(2^16) Reed-Solomon erasure codec — data-availability sampling
+// track (own extern "C" exports: rs_encode16, rs_reconstruct16,
+// rs_gf16_threads; uses the pool from rlc_packer.inc)
+#include "rs_gf16.inc"

@@ -1,0 +1,16 @@
+"""Data-availability sampling subsystem (ROADMAP #3).
+
+Block data is erasure-coded with a systematic Reed-Solomon code over
+GF(2^16) (`rs.py` numpy oracle, `csrc/rs_gf16.inc` native engine), the
+extended chunks are committed into an RFC-6962 Merkle tree whose root
+rides the header as `da_root` (`commit.py`), the proposer-side node
+retains recent extended blocks and serves per-sample opening proofs
+(`serve.py`), and light clients draw seeded random indices and verify
+proofs until a configurable confidence that the block is
+reconstructable (`sampler.py`).
+"""
+
+from .commit import DACommitment, block_payload, extend_payload  # noqa: F401
+from .rs import RSError, encode_shards, reconstruct_shards  # noqa: F401
+from .sampler import Sampler, SampleResult  # noqa: F401
+from .serve import DAServe  # noqa: F401

@@ -1,0 +1,139 @@
+"""DA commitments: extended-chunk Merkle root + per-sample openings.
+
+The committed payload is the block's Data proto encoding — for
+columnar blocks that is the memoized `TxColumns.encode_data()` buffer,
+so the bytes the DA encoder consumes are the SAME buffer block
+serialization already built (zero-copy; nothing re-materializes
+per-tx). The payload is split into k equal data shards (implicitly
+zero-padded), RS-extended to n = k+m shards, each shard is hashed, and
+the chunk hashes go into an RFC-6962 tree (crypto/merkle, same
+0x00/0x01 leaf/inner domain separation as light/mmr.py). Like the MMR,
+the final `da_root` binds the tree shape under a 0x02 root prefix —
+here (n, k, payload_len, chunks_root) — so a sampler cannot be lied to
+about the geometry its confidence math depends on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from ..crypto import merkle
+from .rs import RSError, encode_shards
+
+# same domain-separation discipline as light/mmr.py: 0x00/0x01 are
+# RFC-6962 leaf/inner (crypto/merkle), 0x02 binds the root metadata
+ROOT_PREFIX = b"\x02"
+
+_ROOT_FMT = ">IIQ"  # n, k, payload_len
+
+
+def _sha256(b) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+@dataclass(frozen=True)
+class DACommitment:
+    """Geometry + chunk-hash root a sampler verifies openings against."""
+
+    n: int  # total extended shards (k data + m parity)
+    k: int  # data shards (any k of n reconstruct the payload)
+    payload_len: int  # unpadded payload bytes (strip point on decode)
+    chunks_root: bytes  # RFC-6962 root over sha256(shard) leaves
+
+    def root(self) -> bytes:
+        return _sha256(
+            ROOT_PREFIX
+            + struct.pack(_ROOT_FMT, self.n, self.k, self.payload_len)
+            + self.chunks_root
+        )
+
+    def verify_sample(
+        self, index: int, chunk: bytes, proof: merkle.Proof
+    ) -> bool:
+        if index != proof.index or proof.total != self.n:
+            return False
+        return proof.verify(self.chunks_root, _sha256(chunk))
+
+
+def shard_length(payload_len: int, k: int) -> int:
+    """Even per-shard byte length covering the payload; >= 2 so empty
+    blocks still commit to k well-formed one-word shards."""
+    words = max(1, -(-payload_len // (2 * k)))
+    return 2 * words
+
+
+def split_payload(payload, k: int) -> list[bytes]:
+    """k equal data shards, zero-padded; accepts bytes or memoryview
+    (one copy of the payload total, into the shard slices)."""
+    mv = memoryview(payload)
+    shard_len = shard_length(len(mv), k)
+    out = []
+    for j in range(k):
+        piece = bytes(mv[j * shard_len:(j + 1) * shard_len])
+        if len(piece) < shard_len:
+            piece = piece + b"\x00" * (shard_len - len(piece))
+        out.append(piece)
+    return out
+
+
+def join_payload(data_shards: list[bytes], payload_len: int) -> bytes:
+    return b"".join(data_shards)[:payload_len]
+
+
+def extend_payload(
+    payload, k: int, m: int, *, nchunks: int = 0
+) -> list[bytes]:
+    """Full extended shard list: k data shards + m RS parity shards."""
+    data = split_payload(payload, k)
+    return data + encode_shards(data, m, nchunks=nchunks)
+
+
+def commit_shards(
+    shards: list[bytes], k: int, payload_len: int
+) -> tuple[DACommitment, list[merkle.Proof]]:
+    """Commitment + one opening proof per extended chunk."""
+    hashes = [_sha256(s) for s in shards]
+    chunks_root, proofs = merkle.proofs_from_byte_slices(hashes)
+    com = DACommitment(
+        n=len(shards), k=k, payload_len=payload_len, chunks_root=chunks_root
+    )
+    return com, proofs
+
+
+def block_payload(data) -> bytes:
+    """The byte string the DA code commits to: the Data proto encoding
+    (memoized single buffer for TxColumns-backed blocks)."""
+    return data.encode()
+
+
+def da_root_for_data(data, k: int, m: int, *, nchunks: int = 0) -> bytes:
+    """Proposal/validation-time root: encode + commit, root only."""
+    payload = block_payload(data)
+    shards = extend_payload(payload, k, m, nchunks=nchunks)
+    com, _ = commit_shards(shards, k, len(payload))
+    return com.root()
+
+
+def proof_num_bytes(chunk: bytes, proof: merkle.Proof) -> int:
+    """Wire-cost accounting for one sample: chunk + leaf hash + aunts
+    + the fixed (total, index) header. Mirrors MMRProof.num_bytes()."""
+    return len(chunk) + 32 * (1 + len(proof.aunts)) + 12
+
+
+def reconstruct_payload(
+    shards: list[bytes | None], com: DACommitment, *, nchunks: int = 0
+) -> bytes:
+    """Recover the payload from any >= k surviving shards and verify it
+    against the commitment (re-derives the root; raises RSError when
+    the survivors do not re-commit to the same da_root)."""
+    from .rs import reconstruct_shards
+
+    full = reconstruct_shards(
+        shards, com.k, com.n - com.k, nchunks=nchunks
+    )
+    got, _ = commit_shards(full, com.k, com.payload_len)
+    if got.root() != com.root():
+        raise RSError("reconstructed shards do not match the commitment")
+    return join_payload(full[: com.k], com.payload_len)

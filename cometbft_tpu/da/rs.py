@@ -1,0 +1,254 @@
+"""Systematic Reed-Solomon erasure code over GF(2^16) — numpy oracle.
+
+The code is RS in *evaluation form*: the k data shards are the values
+of the unique degree-<k polynomial at the points x = 0..k-1, and the m
+parity shards are its evaluations at x = k..k+m-1. That makes the code
+systematic by construction, and reconstruction from ANY k of the
+n = k+m shards is Lagrange interpolation over the surviving points.
+Shards are arrays of little-endian uint16 words; all shard arithmetic
+is word-wise, so every output word depends only on the same word
+column of the inputs — the property the native engine exploits to
+parallelize over word ranges with chunk-count-invariant output.
+
+Field: GF(2^16) under the primitive polynomial
+x^16 + x^12 + x^3 + x + 1 (0x1100B); 2 generates the multiplicative
+group (checked in tests), so the log/antilog tables come from a plain
+shift-xor loop. `csrc/rs_gf16.inc` builds the identical tables — the
+differential tests in tests/test_rs_native.py hold the two
+implementations bit-equal on encode AND reconstruct.
+
+The module-level `encode_shards` / `reconstruct_shards` prefer the
+native engine and fall back to this oracle when the shared library is
+unavailable (same graceful-degradation contract as the other csrc
+engines).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GF_POLY = 0x1100B  # primitive; x is a generator (order(2) == 65535)
+GF_ORDER = 1 << 16
+GF_GROUP = GF_ORDER - 1  # multiplicative group order
+
+# practical cap on total shards: keeps the O(k^2) Lagrange denominator
+# pass bounded and matches RS_MAX_SHARDS in csrc/rs_gf16.inc
+MAX_SHARDS = 4096
+
+
+class RSError(Exception):
+    pass
+
+
+_EXP = None  # length 2*GF_GROUP so (log a + log b) indexes without a mod
+_LOG = None
+
+
+def _tables() -> tuple[np.ndarray, np.ndarray]:
+    global _EXP, _LOG
+    if _EXP is None:
+        exp = np.zeros(2 * GF_GROUP, dtype=np.uint16)
+        log = np.zeros(GF_ORDER, dtype=np.uint32)
+        x = 1
+        for i in range(GF_GROUP):
+            exp[i] = x
+            log[x] = i
+            x <<= 1
+            if x & GF_ORDER:
+                x ^= GF_POLY
+        exp[GF_GROUP:] = exp[:GF_GROUP]
+        _EXP, _LOG = exp, log
+    return _EXP, _LOG
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    exp, log = _tables()
+    return int(exp[int(log[a]) + int(log[b])])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^16) division by zero")
+    if a == 0:
+        return 0
+    exp, log = _tables()
+    return int(exp[int(log[a]) + GF_GROUP - int(log[b])])
+
+
+def gf_inv(a: int) -> int:
+    return gf_div(1, a)
+
+
+def _mul_vec(c: int, vec: np.ndarray) -> np.ndarray:
+    """Scalar * vector over GF(2^16), vectorized through the tables."""
+    if c == 0:
+        return np.zeros_like(vec)
+    exp, log = _tables()
+    out = exp[int(log[c]) + log[vec]]
+    # log[0] is a dummy slot — zero inputs must map to zero outputs
+    np.copyto(out, 0, where=(vec == 0))
+    return out
+
+
+def _lagrange_rows(xs: list[int], ys: list[int]) -> list[list[int]]:
+    """Coefficient rows for evaluating the degree-<k interpolant of
+    points xs at each target y: out[r][j] is the weight of shard xs[j]
+    in shard ys[r]. In GF(2^n), (a - b) == (a XOR b), so the classic
+    Lagrange basis w_j(y) = P(y) / ((y^xs_j) * d_j) with
+    P(y) = prod_i (y ^ xs_i) and d_j = prod_{i!=j} (xs_j ^ xs_i).
+    O(k^2 + len(ys)*k) total, not O(len(ys)*k^2)."""
+    k = len(xs)
+    dens = []
+    for j in range(k):
+        d = 1
+        xj = xs[j]
+        for i in range(k):
+            if i != j:
+                d = gf_mul(d, xj ^ xs[i])
+        dens.append(d)
+    rows = []
+    for y in ys:
+        if y in xs:
+            rows.append([1 if xs[j] == y else 0 for j in range(k)])
+            continue
+        p = 1
+        for xi in xs:
+            p = gf_mul(p, y ^ xi)
+        rows.append(
+            [gf_div(p, gf_mul(y ^ xs[j], dens[j])) for j in range(k)]
+        )
+    return rows
+
+
+def _check_params(k: int, m: int) -> None:
+    if k < 1 or m < 0 or k + m > MAX_SHARDS:
+        raise RSError(f"bad RS parameters k={k} m={m} (max {MAX_SHARDS})")
+
+
+def _as_words(shard: bytes) -> np.ndarray:
+    if len(shard) % 2:
+        raise RSError("shard length must be a whole number of uint16 words")
+    return np.frombuffer(shard, dtype="<u2")
+
+
+def encode_oracle(data_shards: list[bytes], m: int) -> list[bytes]:
+    """Pure-numpy parity computation: m new shards extending the k
+    given data shards. All shards must be equal even length."""
+    k = len(data_shards)
+    _check_params(k, m)
+    if m == 0:
+        return []
+    arrs = [_as_words(s) for s in data_shards]
+    words = len(arrs[0])
+    if any(len(a) != words for a in arrs):
+        raise RSError("data shards must be equal length")
+    rows = _lagrange_rows(list(range(k)), list(range(k, k + m)))
+    out = []
+    for r in range(m):
+        acc = np.zeros(words, dtype=np.uint16)
+        for j in range(k):
+            c = rows[r][j]
+            if c:
+                acc ^= _mul_vec(c, arrs[j])
+        out.append(acc.astype("<u2").tobytes())
+    return out
+
+
+def reconstruct_oracle(
+    shards: list[bytes | None], k: int, m: int
+) -> list[bytes]:
+    """Fill in every missing shard from any >= k survivors.
+
+    `shards` is the full n = k+m list with None marking erasures. The
+    interpolation set is the first k present shards in index order —
+    a deterministic rule the native engine mirrors exactly.
+    """
+    _check_params(k, m)
+    n = k + m
+    if len(shards) != n:
+        raise RSError(f"expected {n} shard slots, got {len(shards)}")
+    present = [i for i, s in enumerate(shards) if s is not None]
+    if len(present) < k:
+        raise RSError(
+            f"unrecoverable: {len(present)} shards present, need {k}"
+        )
+    xs = present[:k]
+    arrs = [_as_words(shards[i]) for i in xs]
+    words = len(arrs[0])
+    if any(len(a) != words for a in arrs):
+        raise RSError("shards must be equal length")
+    missing = [i for i, s in enumerate(shards) if s is None]
+    rows = _lagrange_rows(xs, missing)
+    out = list(shards)
+    for r, y in enumerate(missing):
+        acc = np.zeros(words, dtype=np.uint16)
+        for j in range(k):
+            c = rows[r][j]
+            if c:
+                acc ^= _mul_vec(c, arrs[j])
+        out[y] = acc.astype("<u2").tobytes()
+    return out  # type: ignore[return-value]
+
+
+# ------------------------------------------------------------------ dispatch
+
+def encode_shards(
+    data_shards: list[bytes], m: int, *, nchunks: int = 0
+) -> list[bytes]:
+    """Parity shards via the native engine when available, oracle
+    otherwise. Output is bit-identical either way (differential-tested)."""
+    k = len(data_shards)
+    _check_params(k, m)
+    if m == 0:
+        return []
+    from ..crypto import native
+
+    if native.rs_available():
+        shard_len = len(data_shards[0])
+        if shard_len % 2 or any(len(s) != shard_len for s in data_shards):
+            raise RSError("data shards must be equal even length")
+        parity = native.rs_encode(
+            b"".join(data_shards), k, m, shard_len, nchunks=nchunks
+        )
+        if parity is not None:
+            return [
+                parity[i * shard_len:(i + 1) * shard_len] for i in range(m)
+            ]
+    return encode_oracle(data_shards, m)
+
+
+def reconstruct_shards(
+    shards: list[bytes | None], k: int, m: int, *, nchunks: int = 0
+) -> list[bytes]:
+    """Reconstruct all n shards from any >= k survivors (native when
+    available, oracle otherwise); counts into da_reconstruct_total."""
+    from ..utils.metrics import da_metrics
+
+    da_metrics().reconstruct_total.inc()
+    _check_params(k, m)
+    n = k + m
+    if len(shards) != n:
+        raise RSError(f"expected {n} shard slots, got {len(shards)}")
+    from ..crypto import native
+
+    if native.rs_available():
+        lens = {len(s) for s in shards if s is not None}
+        if len(lens) == 1 and not (shard_len := lens.pop()) % 2:
+            present = bytes(1 if s is not None else 0 for s in shards)
+            if sum(present) < k:
+                raise RSError(
+                    f"unrecoverable: {sum(present)} shards present, need {k}"
+                )
+            buf = b"".join(
+                s if s is not None else b"\x00" * shard_len for s in shards
+            )
+            out = native.rs_reconstruct(
+                buf, present, k, m, shard_len, nchunks=nchunks
+            )
+            if out is not None:
+                return [
+                    out[i * shard_len:(i + 1) * shard_len] for i in range(n)
+                ]
+    return reconstruct_oracle(shards, k, m)

@@ -1,0 +1,153 @@
+"""DAS client: seeded random sampling against a header's da_root.
+
+The availability argument: a block is reconstructable unless MORE than
+m of the n = k+m extended chunks are unavailable (any k survivors
+reconstruct). So an adversary hiding the data must withhold >= m+1
+chunks, and a uniformly random sample then fails with probability
+>= (m+1)/n. After s independent samples that ALL verify,
+P(block actually unavailable) <= (1 - (m+1)/n)^s — the client's
+confidence is one minus that. With the default k = m (rate-1/2
+extension) each sample halves the doubt, so ~7 samples reach 99%.
+
+Index draws are seeded (sha256 counter stream over
+seed/client_id/height/da_root), so a fleet of clients is reproducible
+end-to-end while still sampling independently per client.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+from dataclasses import dataclass, field
+
+from ..utils import trace
+from .commit import DACommitment, proof_num_bytes
+
+
+def confidence_after(samples_ok: int, n: int, m: int) -> float:
+    """P[reconstructable] lower bound after `samples_ok` verified
+    samples of an n-chunk extension with parity budget m."""
+    if n <= 0 or samples_ok <= 0:
+        return 0.0
+    p_hit = (m + 1) / n
+    if p_hit >= 1.0:
+        return 1.0
+    return 1.0 - (1.0 - p_hit) ** samples_ok
+
+
+def samples_for_confidence(target: float, n: int, m: int) -> int:
+    """Smallest s with confidence_after(s, n, m) >= target."""
+    if not 0.0 < target < 1.0:
+        raise ValueError("confidence target must be in (0, 1)")
+    p_hit = (m + 1) / n
+    if p_hit >= 1.0:
+        return 1
+    return max(1, math.ceil(math.log(1.0 - target) / math.log(1.0 - p_hit)))
+
+
+@dataclass
+class SampleResult:
+    height: int
+    confident: bool  # reached the target with zero failures
+    confidence: float  # achieved lower bound
+    samples_ok: int = 0
+    samples_failed: int = 0
+    failed_indices: list = field(default_factory=list)
+    proof_bytes: int = 0  # total wire bytes across this client's samples
+
+    @property
+    def detected_withholding(self) -> bool:
+        return self.samples_failed > 0
+
+
+class Sampler:
+    """One light client's sampling loop.
+
+    `fetch(height, index)` is the transport: it returns
+    (chunk, proof, commitment-ish) or None (unavailable/withheld) —
+    backed by the `da_sample` RPC route or an in-process DAServe.
+    """
+
+    def __init__(
+        self,
+        client_id: int,
+        n: int,
+        k: int,
+        *,
+        samples: int = 0,
+        confidence: float = 0.99,
+        seed: int = 0,
+    ):
+        self.client_id = client_id
+        self.n = n
+        self.k = k
+        self.m = n - k
+        self.confidence_target = confidence
+        self.samples = samples or samples_for_confidence(
+            confidence, n, self.m
+        )
+        self.seed = seed
+
+    def indices(self, height: int, da_root: bytes) -> list[int]:
+        """Seeded draw of `samples` indices in [0, n) — deterministic
+        per (seed, client, height, root), uniform via rejection."""
+        out: list[int] = []
+        ctr = 0
+        base = hashlib.sha256(
+            struct.pack(">QQQ", self.seed, self.client_id, height) + da_root
+        ).digest()
+        limit = (1 << 32) - ((1 << 32) % self.n)
+        while len(out) < self.samples:
+            block = hashlib.sha256(
+                base + struct.pack(">Q", ctr)
+            ).digest()
+            ctr += 1
+            for off in range(0, 32, 4):
+                v = int.from_bytes(block[off:off + 4], "big")
+                if v < limit:
+                    out.append(v % self.n)
+                    if len(out) == self.samples:
+                        break
+        return out
+
+    def verify_sample(
+        self, com: DACommitment, da_root: bytes, index: int,
+        chunk: bytes, proof,
+    ) -> bool:
+        """One opening proof checked end-to-end: geometry matches the
+        header root, chunk hash sits at `index` under chunks_root."""
+        with trace.span(
+            "da.sample_verify", index=index, n=com.n
+        ) as sp:
+            ok = com.root() == da_root and com.verify_sample(
+                index, chunk, proof
+            )
+            sp.add(ok=ok)
+        return ok
+
+    def run(self, height: int, da_root: bytes, fetch) -> SampleResult:
+        ok = 0
+        failed: list[int] = []
+        nbytes = 0
+        for index in self.indices(height, da_root):
+            got = fetch(height, index)
+            if got is None:
+                failed.append(index)
+                continue
+            chunk, proof, com = got
+            if not self.verify_sample(com, da_root, index, chunk, proof):
+                failed.append(index)
+                continue
+            ok += 1
+            nbytes += proof_num_bytes(chunk, proof)
+        conf = confidence_after(ok, self.n, self.m)
+        return SampleResult(
+            height=height,
+            confident=not failed and conf >= self.confidence_target,
+            confidence=conf,
+            samples_ok=ok,
+            samples_failed=len(failed),
+            failed_indices=failed,
+            proof_bytes=nbytes,
+        )

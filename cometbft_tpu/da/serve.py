@@ -1,0 +1,160 @@
+"""Node-side DA serving surface.
+
+`DAServe` rides the same commit-time event-handler hook as the light
+MMR accumulator (`BlockExecutor.event_handlers`): every applied block's
+payload is RS-extended, committed, and retained for the last
+`retain_heights` heights so samplers can fetch (chunk, opening proof)
+pairs through the `da_sample` RPC route or the `/light_stream` payload
+extension. It doubles as the proposal/validation encoder: the executor
+asks it for `da_root_for(data)` when building a proposal and when
+checking a peer's header.
+
+An explicit withholding knob (`set_withholding`) exists for the
+adversarial workload: a byzantine proposer that advertises a root but
+refuses to serve some chunks. Samplers hitting a withheld index get
+None — exactly the observable a DAS client turns into a
+detection/alarm (tools/dasload.py drives a fleet against it).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..utils import trace
+from ..utils.metrics import da_metrics
+from .commit import (
+    DACommitment,
+    block_payload,
+    commit_shards,
+    extend_payload,
+    proof_num_bytes,
+)
+
+
+class _HeightEntry:
+    __slots__ = ("commitment", "shards", "proofs", "da_root")
+
+    def __init__(self, commitment, shards, proofs):
+        self.commitment = commitment
+        self.shards = shards
+        self.proofs = proofs
+        self.da_root = commitment.root()
+
+
+class DAServe:
+    def __init__(self, cfg):
+        """`cfg` is the validated `config.DAConfig`."""
+        self.cfg = cfg
+        self.k = cfg.data_shards
+        self.m = cfg.parity_shards
+        self._lock = threading.Lock()
+        self._heights: OrderedDict[int, _HeightEntry] = OrderedDict()
+        self._withhold: dict[int, set[int]] = {}
+        self._encoded = 0
+        self._served = 0
+        self._withheld_hits = 0
+        self.metrics = da_metrics()
+
+    # --------------------------------------------------------- encoder side
+    def da_root_for(self, data) -> bytes:
+        """Root for a proposal's Data (also used to validate a peer's
+        header against locally re-encoded chunks)."""
+        payload = block_payload(data)
+        shards = extend_payload(payload, self.k, self.m)
+        com, _ = commit_shards(shards, self.k, len(payload))
+        return com.root()
+
+    def on_commit(self, block, resp=None) -> None:
+        """Commit-time hook (same contract as LightServe.on_commit):
+        extend + commit + retain the applied block's payload."""
+        header = block.header
+        payload = block_payload(block.data)
+        with trace.span(
+            "da.encode", height=header.height, bytes=len(payload)
+        ) as sp:
+            shards = extend_payload(payload, self.k, self.m)
+            com, proofs = commit_shards(shards, self.k, len(payload))
+            sp.add(shards=com.n, shard_bytes=len(shards[0]))
+        entry = _HeightEntry(com, shards, proofs)
+        with self._lock:
+            self._heights[header.height] = entry
+            self._encoded += 1
+            while len(self._heights) > self.cfg.retain_heights:
+                h, _ = self._heights.popitem(last=False)
+                self._withhold.pop(h, None)
+
+    # --------------------------------------------------------- serving side
+    def set_withholding(self, height: int, indices) -> None:
+        """Adversarial harness: refuse to serve `indices` at `height`."""
+        with self._lock:
+            self._withhold[height] = set(indices)
+
+    def stream_fields(self, height: int) -> dict:
+        """/light_stream payload extension for one height ({} when the
+        height is not retained — e.g. DA enabled mid-run)."""
+        with self._lock:
+            entry = self._heights.get(height)
+        if entry is None:
+            return {}
+        com = entry.commitment
+        return {
+            "da_root": entry.da_root.hex(),
+            "da_shards": com.n,
+            "da_data_shards": com.k,
+            "da_payload_len": com.payload_len,
+        }
+
+    def sample(self, height: int, index: int):
+        """(chunk, Proof, DACommitment) for one sampled index, or None
+        when the height is unknown / the index is withheld."""
+        with self._lock:
+            entry = self._heights.get(height)
+            withheld = self._withhold.get(height, ())
+        if entry is None or not (0 <= index < entry.commitment.n):
+            return None
+        if index in withheld:
+            with self._lock:
+                self._withheld_hits += 1
+            return None
+        chunk = entry.shards[index]
+        proof = entry.proofs[index]
+        nbytes = proof_num_bytes(chunk, proof)
+        with trace.span(
+            "da.serve_sample", height=height, index=index, bytes=nbytes
+        ):
+            self.metrics.samples_served_total.inc()
+            self.metrics.proof_bytes.observe(nbytes)
+            with self._lock:
+                self._served += 1
+        return chunk, proof, entry.commitment
+
+    def commitment(self, height: int) -> DACommitment | None:
+        with self._lock:
+            entry = self._heights.get(height)
+        return entry.commitment if entry is not None else None
+
+    def shards(self, height: int) -> list[bytes] | None:
+        with self._lock:
+            entry = self._heights.get(height)
+        return list(entry.shards) if entry is not None else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            heights = list(self._heights)
+            return {
+                "enabled": True,
+                "data_shards": self.k,
+                "parity_shards": self.m,
+                "retained_heights": len(heights),
+                "min_height": heights[0] if heights else 0,
+                "max_height": heights[-1] if heights else 0,
+                "blocks_encoded": self._encoded,
+                "samples_served": self._served,
+                "withheld_hits": self._withheld_hits,
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            self._heights.clear()
+            self._withhold.clear()

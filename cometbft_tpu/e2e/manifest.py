@@ -67,6 +67,10 @@ class Manifest:
     # enable ABCI vote extensions from this height via the genesis
     # consensus params (reference manifest.go VoteExtensionsEnableHeight)
     vote_extensions_enable_height: int = 0
+    # every node erasure-codes committed payloads and carries a DA
+    # commitment in the header (config [da]); the runner's invariant
+    # check then verifies da_root consistency across the stores
+    da_enabled: bool = False
 
     @classmethod
     def parse(cls, d: dict) -> "Manifest":
@@ -84,6 +88,7 @@ class Manifest:
             vote_extensions_enable_height=int(
                 d.get("vote_extensions_enable_height", 0)
             ),
+            da_enabled=bool(d.get("da_enabled", False)),
         )
 
 
@@ -154,4 +159,7 @@ def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
         # nodes leave on disk, which the mem backend would not persist
         db_backend="sqlite",
         timeout_commit=rng.choice([0.1, 0.2, 0.4]),
+        # half the generated nets run with DA commitments in the
+        # header — consensus must be byte-compatible either way
+        da_enabled=rng.random() < 0.5,
     )

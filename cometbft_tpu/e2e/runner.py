@@ -218,6 +218,10 @@ class Runner:
             cfg.base.abci_call_log = True
             # every node snapshots so statesync joiners find providers
             cfg.base.snapshot_interval = 2
+            # DA manifests: every node encodes + enforces the header's
+            # da_root (proposers and validators must agree on it, so
+            # it's all-or-nothing across the net)
+            cfg.da.enabled = m.da_enabled
             # prometheus endpoint per node so the runner can assert live
             # series mid-run (reference test/e2e enabling instrumentation)
             mport = self.starting_port + 2 * len(m.nodes) + i
@@ -642,10 +646,20 @@ class Runner:
     def check_invariants(self) -> dict:
         """Block-hash and app-hash agreement at every common height,
         checked from the stores the stopped nodes left behind (black-box:
-        the same data the /block RPC serves)."""
+        the same data the /block RPC serves). DA manifests additionally
+        re-derive every header's da_root from the stored block payload —
+        the commitment a sampling client trusts must match the data the
+        chain actually carries."""
         from ..storage import BlockStore, open_kv
 
+        da_check = None
+        if self.manifest.da_enabled:
+            from ..config import DAConfig
+            from ..da import DAServe
+
+            da_check = DAServe(DAConfig(enabled=True))
         chains: dict[str, dict[int, tuple[bytes, bytes]]] = {}
+        da_roots_checked = 0
         for name, n in self.nodes.items():
             bs = BlockStore(
                 open_kv(os.path.join(n.home, "data", "blockstore.db"))
@@ -655,6 +669,14 @@ class Runner:
                 blk = bs.load_block(h)
                 if blk is not None:
                     by_h[h] = (blk.hash(), bytes(blk.header.app_hash))
+                    if da_check is not None:
+                        if (blk.header.da_root
+                                != da_check.da_root_for(blk.data)):
+                            raise E2EError(
+                                f"{name} height {h}: header da_root does "
+                                "not re-derive from the stored payload"
+                            )
+                        da_roots_checked += 1
             chains[name] = by_h
         heights = [max(c) if c else 0 for c in chains.values()]
         if not heights or max(heights) < self.manifest.target_height:
@@ -672,11 +694,14 @@ class Runner:
                             f"hash divergence at height {h}: {a} vs {b}"
                         )
         grammar = self.check_abci_grammar()
-        return {
+        out = {
             "heights": dict(zip(chains, heights)),
             "txs_sent": self.txs_sent,
             "abci_executions": grammar,
         }
+        if da_check is not None:
+            out["da_roots_checked"] = da_roots_checked
+        return out
 
     def check_abci_grammar(self) -> dict:
         """Validate every node's recorded ABCI call sequence against the

@@ -197,6 +197,9 @@ class LightServe:
         self._next_sub_id = 0
         self._lock = threading.Lock()
         self.heights_served = 0
+        # optional da.DAServe (node wiring): stream payloads then carry
+        # the height's DA commitment fields for sampling clients
+        self.da_serve = None
 
     # -- commit hook -----------------------------------------------------
     def on_commit(self, block, resp=None) -> None:
@@ -241,7 +244,7 @@ class LightServe:
         """One shared dict per height — rendered once, pushed to every
         subscriber queue by reference."""
         proof = self._prove_locked(header.height)
-        return {
+        payload = {
             "height": header.height,
             "hash": header.hash().hex().upper(),
             "time": str(header.time),
@@ -252,6 +255,11 @@ class LightServe:
             "mmr_root": self.mmr.root().hex().upper(),
             "mmr_proof": proof.encode().hex(),
         }
+        if self.da_serve is not None:
+            # DA commit hook runs before this one (node wiring order), so
+            # the height's commitment is already encoded and retained
+            payload.update(self.da_serve.stream_fields(header.height))
+        return payload
 
     # -- MMR proofs ------------------------------------------------------
     def _leaf_index(self, height: int) -> int:

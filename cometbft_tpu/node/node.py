@@ -58,7 +58,8 @@ class Node:
             _metrics.consensus_metrics, _metrics.mempool_metrics,
             _metrics.p2p_metrics, _metrics.state_metrics,
             _metrics.blocksync_metrics, _metrics.statesync_metrics,
-            _metrics.light_metrics, _metrics.crypto_metrics,
+            _metrics.light_metrics, _metrics.da_metrics,
+            _metrics.crypto_metrics,
         ):
             _mk()
         if config.instrumentation.trace_sink and not _trace.enabled:
@@ -222,6 +223,19 @@ class Node:
         self.pruner = Pruner(self.block_store, self.state_store)
         self.executor.pruner = self.pruner
 
+        # --- data-availability sampling surface -------------------------
+        self.da_serve = None
+        if config.da.enabled:
+            from ..da import DAServe
+
+            self.da_serve = DAServe(config.da)
+            # proposal side: create_proposal_block stamps da_root into
+            # the header; apply_block re-derives and enforces it
+            self.executor.da_encoder = self.da_serve
+            # commit hook BEFORE the light handler (below): /light_stream
+            # payload rendering must find the height's shards encoded
+            self.executor.event_handlers.append(self.da_serve.on_commit)
+
         # --- light-client serving surface ------------------------------
         self.light_serve = None
         if config.light.serve:
@@ -244,6 +258,8 @@ class Node:
             # executor event handler: fires on consensus commits AND
             # blocksync replay, so the accumulator never misses a height
             self.executor.event_handlers.append(self.light_serve.on_commit)
+            # stream DA commitment fields in /light_stream payloads
+            self.light_serve.da_serve = self.da_serve
 
         # --- consensus -------------------------------------------------
         self.wal = WAL(_p(config.consensus.wal_file))
@@ -357,6 +373,7 @@ class Node:
             evidence_pool=self.evidence_pool,
             consensus_reactor=self.consensus_reactor,
             light_serve=self.light_serve,
+            da_serve=self.da_serve,
         )
         self.rpc_server = None
         self.grpc_server = None
@@ -579,6 +596,8 @@ class Node:
         self.pruner.stop()
         if self.light_serve is not None:
             self.light_serve.stop()  # closes subscriber queues
+        if self.da_serve is not None:
+            self.da_serve.stop()  # drops retained shard sets
         if self.pex_reactor is not None:
             self.pex_reactor.stop()  # also persists the address book
         self.consensus_reactor.stop()

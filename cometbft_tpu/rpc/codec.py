@@ -49,6 +49,7 @@ def header_from_json(d: dict) -> Header:
         last_results_hash=_hb(d.get("last_results_hash")),
         evidence_hash=_hb(d.get("evidence_hash")),
         proposer_address=_hb(d.get("proposer_address")),
+        da_root=_hb(d.get("da_root")),
     )
 
 

@@ -28,11 +28,12 @@ class Env:
                  mempool=None, switch=None, event_bus=None, tx_indexer=None,
                  block_indexer=None, genesis_doc=None, app_conns=None,
                  node_info=None, evidence_pool=None, pex_reactor=None,
-                 consensus_reactor=None, light_serve=None):
+                 consensus_reactor=None, light_serve=None, da_serve=None):
         self.evidence_pool = evidence_pool
         self.pex_reactor = pex_reactor
         self.consensus_reactor = consensus_reactor
         self.light_serve = light_serve
+        self.da_serve = da_serve
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -84,6 +85,7 @@ def _header_json(h) -> dict:
         "last_results_hash": _hx(h.last_results_hash),
         "evidence_hash": _hx(h.evidence_hash),
         "proposer_address": _hx(h.proposer_address),
+        "da_root": _hx(h.da_root),
     }
 
 
@@ -381,17 +383,31 @@ def net_info(env, params):
     }
 
 
+def _rs_lock(cs):
+    """The consensus round-state mutex (consensus.state rs_mutex): the
+    consensus thread holds it across every _process, so acquiring it
+    here yields a snapshot that cannot mix two heights' fields. Stubbed
+    consensus objects (tests) without the mutex degrade to lock-free."""
+    lock = getattr(cs, "rs_mutex", None)
+    if lock is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return lock
+
+
 def consensus_state(env, params):
     cs = env.consensus
-    return {
-        "round_state": {
-            "height": str(cs.height),
-            "round": cs.round,
-            "step": int(cs.step),
-            "locked_round": cs.locked_round,
-            "valid_round": cs.valid_round,
+    with _rs_lock(cs):
+        return {
+            "round_state": {
+                "height": str(cs.height),
+                "round": cs.round,
+                "step": int(cs.step),
+                "locked_round": cs.locked_round,
+                "valid_round": cs.valid_round,
+            }
         }
-    }
 
 
 def _vote_set_json(vs) -> dict | None:
@@ -415,32 +431,26 @@ def dump_consensus_state(env, params):
     reactor's per-peer (height, round, step) view for operators
     debugging a stall.
 
-    Consistency: the consensus thread mutates state concurrently, so a
-    naive field-by-field read can mix heights (e.g. height N's round
-    with height N+1's locked block). Each attempt samples (height,
-    round) before and after gathering and retries on movement; after a
-    few tries the last snapshot is returned as-is — the endpoint is
-    documented best-effort, matching an operator's needs during a stall
-    (when state is static) without blocking consensus to serve RPC."""
+    Consistency: the consensus thread mutates the round state
+    concurrently, and a naive field-by-field read could mix heights
+    (e.g. height N's round with height N+1's locked block). The gather
+    runs under cs.rs_mutex — held by the consensus thread across each
+    _process transition — so the snapshot is a single consistent round
+    state, replacing the old sample-and-retry heuristic (which could
+    still return a torn snapshot after its retry budget)."""
     cs = env.consensus
-    for _attempt in range(3):
-        h0, r0 = cs.height, cs.round
+    with _rs_lock(cs):
         votes = []
-        # snapshot under the GIL: the consensus thread inserts rounds
-        # into _sets concurrently (height_vote_set.py _ensure_round) and
-        # a live dict iteration would intermittently raise; dict.copy()
-        # is atomic and prevotes/precommits are .get()-safe for rounds
-        # added after
         hvs = cs.votes
-        for r in sorted(hvs._sets.copy()):
+        for r in sorted(hvs._sets):
             votes.append({
                 "round": r,
                 "prevotes": _vote_set_json(hvs.prevotes(r)),
                 "precommits": _vote_set_json(hvs.precommits(r)),
             })
         rs = {
-            "height": str(h0),
-            "round": r0,
+            "height": str(cs.height),
+            "round": cs.round,
             "step": int(cs.step),
             "locked_round": cs.locked_round,
             "locked_block_hash": _hx(
@@ -455,8 +465,6 @@ def dump_consensus_state(env, params):
             "proposal": cs.proposal is not None,
             "height_vote_set": votes,
         }
-        if (cs.height, cs.round) == (h0, r0):
-            break  # nothing moved while we gathered
     peers = []
     reactor = env.consensus_reactor
     if reactor is not None:
@@ -870,6 +878,58 @@ def light_bisect(env, params):
     }
 
 
+def _da_serve(env):
+    if env.da_serve is None:
+        raise RPCError(-32603, "data-availability sampling disabled "
+                               "(config [da] enabled = false)")
+    return env.da_serve
+
+
+def da_status(env, params):
+    """DA serving-surface introspection: shard geometry, retained height
+    window, blocks encoded, samples served, withholding-test hits."""
+    srv = _da_serve(env)
+    st = srv.stats()
+    st["min_height"] = str(st["min_height"] or 0)
+    st["max_height"] = str(st["max_height"] or 0)
+    return st
+
+
+def da_sample(env, params):
+    """One extended-chunk opening: the chunk at `index` of `height`'s
+    erasure-coded payload plus its Merkle path to the header's da_root
+    commitment. Sampling clients (da/sampler.py) call this with seeded
+    random indices and verify each opening against the header."""
+    srv = _da_serve(env)
+    try:
+        h = int(params.get("height", 0))
+        idx = int(params.get("index", -1))
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, "invalid height/index") from e
+    got = srv.sample(h, idx)
+    if got is None:
+        raise RPCError(-32603, f"no sample for height {h} index {idx}")
+    chunk, proof, com = got
+    return {
+        "height": str(h),
+        "index": idx,
+        "chunk": chunk.hex(),
+        "proof": {
+            "total": str(proof.total),
+            "index": str(proof.index),
+            "leaf_hash": _b64(proof.leaf_hash),
+            "aunts": [_b64(a) for a in proof.aunts],
+        },
+        "commitment": {
+            "shards": com.n,
+            "data_shards": com.k,
+            "payload_len": str(com.payload_len),
+            "chunks_root": _hx(com.chunks_root),
+            "da_root": _hx(com.root()),
+        },
+    }
+
+
 # unsafe operator routes, served only when rpc.unsafe is enabled
 # (reference rpc/core/routes.go AddUnsafeRoutes gated by config Unsafe)
 UNSAFE_ROUTES = {
@@ -911,4 +971,6 @@ ROUTES = {
     "light_status": light_status,
     "light_mmr_proof": light_mmr_proof,
     "light_bisect": light_bisect,
+    "da_status": da_status,
+    "da_sample": da_sample,
 }

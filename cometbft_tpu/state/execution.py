@@ -197,6 +197,8 @@ def validate_block(
             raise BlockValidationError("block time != median commit time")
     if not h.proposer_address or len(h.proposer_address) != 20:
         raise BlockValidationError("invalid proposer address")
+    if h.da_root and len(h.da_root) != 32:
+        raise BlockValidationError("invalid da_root length")
 
 
 def build_last_commit_info(block: Block, last_vals: ValidatorSet | None):
@@ -237,6 +239,9 @@ class BlockExecutor:
         self.event_bus = event_bus
         self.event_handlers: list = []
         self.pruner = None  # optional state.pruner.Pruner
+        # optional da.DAServe: when set, proposals carry a DA commitment
+        # in the header and apply_block re-derives and enforces it
+        self.da_encoder = None
 
     # --- proposal side ---
     def create_proposal_block(
@@ -272,6 +277,12 @@ class BlockExecutor:
             time = block_time or state.last_block_time
         else:
             time = median_time(last_commit, state.last_validators)
+        data = Data(txs)
+        da_root = (
+            self.da_encoder.da_root_for(data)
+            if self.da_encoder is not None
+            else b""
+        )
         header = Header(
             version=Consensus(),
             chain_id=state.chain_id,
@@ -279,7 +290,7 @@ class BlockExecutor:
             time=time,
             last_block_id=state.last_block_id,
             last_commit_hash=last_commit.hash(),
-            data_hash=Data(txs).hash(),
+            data_hash=data.hash(),
             validators_hash=state.validators.hash(),
             next_validators_hash=state.next_validators.hash(),
             consensus_hash=state.consensus_params.hash(),
@@ -287,11 +298,25 @@ class BlockExecutor:
             last_results_hash=state.last_results_hash,
             evidence_hash=evidence_list_hash(evidence),
             proposer_address=proposer_address,
+            da_root=da_root,
         )
         return Block(
-            header=header, data=Data(txs), evidence=evidence,
+            header=header, data=data, evidence=evidence,
             last_commit=last_commit,
         )
+
+    def check_da_commitment(self, block: Block) -> None:
+        """With DA enabled, the header's da_root must equal the root
+        re-derived from the block's own payload — a proposer cannot
+        commit to chunks that don't encode the data (apply-side gate;
+        no-op when the node runs without a DA encoder)."""
+        if self.da_encoder is None:
+            return
+        expected = self.da_encoder.da_root_for(block.data)
+        if block.header.da_root != expected:
+            raise BlockValidationError(
+                "wrong da_root" if block.header.da_root else "missing da_root"
+            )
 
     def process_proposal(self, block: Block) -> bool:
         from ..abci.types import ProposalStatus
@@ -329,6 +354,7 @@ class BlockExecutor:
             last_commit_preverified=last_commit_preverified,
         )
         state_metrics().block_verify_time.observe(_time.perf_counter() - t0)
+        self.check_da_commitment(block)
         if self.evidence_pool is not None and block.evidence:
             # reject fabricated misbehavior before it reaches the app
             # (reference internal/state/validation.go evpool.CheckEvidence)

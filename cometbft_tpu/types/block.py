@@ -21,7 +21,7 @@ from ..encoding import proto as pb
 from .basic import BlockID, Timestamp, ZERO_BLOCK_ID, ZERO_TIME
 from .vote import SignedMsgType, canonical_vote_bytes
 
-MAX_HEADER_BYTES = 626
+MAX_HEADER_BYTES = 660  # 626 reference fields + the 34-byte da_root leaf
 BLOCK_PART_SIZE_BYTES = 65536  # reference types/part_set.go BlockPartSizeBytes
 
 
@@ -81,28 +81,34 @@ class Header:
     last_results_hash: bytes = b""
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
+    # DA extension (da/commit.py): root of the erasure-coded chunk
+    # commitment; empty when DA is disabled — and then it contributes
+    # neither a hash leaf nor wire bytes, so legacy headers stay
+    # bit-identical
+    da_root: bytes = b""
 
     def hash(self) -> bytes | None:
         if not self.validators_hash:
             return None
-        return merkle.hash_from_byte_slices(
-            [
-                self.version.encode(),
-                _wrap_string(self.chain_id),
-                _wrap_int64(self.height),
-                self.time.encode(),
-                self.last_block_id.encode(),
-                _wrap_bytes(self.last_commit_hash),
-                _wrap_bytes(self.data_hash),
-                _wrap_bytes(self.validators_hash),
-                _wrap_bytes(self.next_validators_hash),
-                _wrap_bytes(self.consensus_hash),
-                _wrap_bytes(self.app_hash),
-                _wrap_bytes(self.last_results_hash),
-                _wrap_bytes(self.evidence_hash),
-                _wrap_bytes(self.proposer_address),
-            ]
-        )
+        leaves = [
+            self.version.encode(),
+            _wrap_string(self.chain_id),
+            _wrap_int64(self.height),
+            self.time.encode(),
+            self.last_block_id.encode(),
+            _wrap_bytes(self.last_commit_hash),
+            _wrap_bytes(self.data_hash),
+            _wrap_bytes(self.validators_hash),
+            _wrap_bytes(self.next_validators_hash),
+            _wrap_bytes(self.consensus_hash),
+            _wrap_bytes(self.app_hash),
+            _wrap_bytes(self.last_results_hash),
+            _wrap_bytes(self.evidence_hash),
+            _wrap_bytes(self.proposer_address),
+        ]
+        if self.da_root:
+            leaves.append(_wrap_bytes(self.da_root))
+        return merkle.hash_from_byte_slices(leaves)
 
     def encode(self) -> bytes:
         return (
@@ -120,6 +126,7 @@ class Header:
             + pb.f_bytes(12, self.last_results_hash)
             + pb.f_bytes(13, self.evidence_hash)
             + pb.f_bytes(14, self.proposer_address)
+            + pb.f_bytes(15, self.da_root)
         )
 
     @classmethod
@@ -140,6 +147,7 @@ class Header:
             last_results_hash=pb.as_bytes(d.get(12, b"")),
             evidence_hash=pb.as_bytes(d.get(13, b"")),
             proposer_address=pb.as_bytes(d.get(14, b"")),
+            da_root=pb.as_bytes(d.get(15, b"")),
         )
 
 

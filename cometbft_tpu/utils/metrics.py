@@ -466,6 +466,25 @@ class LightClientMetrics:
             "Stream payloads dropped oldest-first on slow subscribers")
 
 
+class DAMetrics:
+    # DA openings carry a whole chunk, so the buckets run larger than
+    # the light-client MMR proof sizes
+    PROOF_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.samples_served_total = reg.counter(
+            "da", "samples_served_total",
+            "Chunk+proof samples served to DAS clients")
+        self.proof_bytes = reg.histogram(
+            "da", "proof_bytes",
+            "Per-sample opening sizes (chunk + Merkle path) served",
+            buckets=self.PROOF_BUCKETS)
+        self.reconstruct_total = reg.counter(
+            "da", "reconstruct_total",
+            "Reed-Solomon reconstructions attempted from sampled shards")
+
+
 class CryptoMetrics:
     BATCH_BUCKETS = (1, 64, 256, 1024, 4096, 10240, 16384, 65536)
 
@@ -536,6 +555,10 @@ def statesync_metrics() -> StateSyncMetrics:
 
 def light_metrics() -> LightClientMetrics:
     return _bundle("light", LightClientMetrics)
+
+
+def da_metrics() -> DAMetrics:
+    return _bundle("da", DAMetrics)
 
 
 def crypto_metrics() -> CryptoMetrics:

@@ -278,6 +278,9 @@ SPAN_REGISTRY = {
     "p2p.recv": "consensus wire message received from a peer (msg/height/round/peer)",
     "light.mmr_append": "one committed header folded into the MMR accumulator (height/leaf/size/dur_ms)",
     "light.serve_proof": "one MMR ancestry proof generated for a light client (height/size/bytes)",
+    "da.encode": "one committed payload erasure-coded + committed (height/bytes/shards/shard_bytes)",
+    "da.serve_sample": "one extended-chunk opening served to a sampling client (height/index)",
+    "da.sample_verify": "one sample proof verified against the header's da_root (index/n/ok)",
 }
 
 

@@ -1,0 +1,594 @@
+"""Data-availability sampling tests (da/, ISSUE 14).
+
+Covers: RS oracle code properties, DA commitments + per-sample opening
+proofs (tamper and geometry-binding rejection), sampling-client
+confidence math and withholding detection, the DAServe commit hook and
+retention window, header da_root wire/hash backward compatibility, the
+executor's proposal/validation seam, [da] config validation, a live
+single-validator node serving da_status/da_sample, and the
+dump_consensus_state snapshot consistency fix (consensus rs_mutex).
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.config import Config, DAConfig
+from cometbft_tpu.da import (
+    DACommitment,
+    DAServe,
+    RSError,
+    Sampler,
+    rs,
+)
+from cometbft_tpu.da import commit as dacommit
+from cometbft_tpu.da import sampler as dasampler
+from cometbft_tpu.rpc.client import LocalClient
+from cometbft_tpu.rpc.routes import Env, RPCError
+from cometbft_tpu.types import Timestamp
+from cometbft_tpu.types.block import Data, Header
+from cometbft_tpu.utils.factories import make_chain
+
+import numpy as np
+
+rng = np.random.default_rng(14)
+
+
+# ------------------------------------------------------------ RS oracle
+
+
+def test_oracle_systematic_and_reconstructs_any_erasure():
+    k, m = 5, 3
+    data = [rng.bytes(20) for _ in range(k)]
+    parity = rs.encode_oracle(data, m)
+    assert len(parity) == m
+    ext = data + parity
+    # systematic: data shards travel unmodified
+    assert ext[:k] == data
+    from itertools import combinations
+
+    for erased in combinations(range(k + m), m):
+        holes = [None if i in erased else s for i, s in enumerate(ext)]
+        assert rs.reconstruct_oracle(holes, k, m) == ext, erased
+
+
+def test_oracle_rejects_beyond_parity_budget():
+    k, m = 4, 2
+    data = [rng.bytes(8) for _ in range(k)]
+    ext = data + rs.encode_oracle(data, m)
+    holes = [None, None, None] + ext[3:]  # m+1 erasures
+    with pytest.raises(RSError):
+        rs.reconstruct_oracle(holes, k, m)
+
+
+def test_rs_param_checks():
+    with pytest.raises(RSError):
+        rs.encode_shards([], 1)
+    with pytest.raises(RSError):
+        rs.encode_shards([b"ab"] * 4000, 200)  # k+m > MAX_SHARDS
+    with pytest.raises(RSError):
+        rs.reconstruct_shards([b"ab"] * 3, 2, 2)  # wrong slot count
+
+
+# ------------------------------------------------- commitment + openings
+
+
+def _commit(payload, k=4, m=4):
+    shards = dacommit.extend_payload(payload, k, m)
+    com, proofs = dacommit.commit_shards(shards, k, len(payload))
+    return shards, com, proofs
+
+
+def test_every_opening_verifies_and_tampering_fails():
+    payload = rng.bytes(333)
+    shards, com, proofs = _commit(payload)
+    for i, (chunk, proof) in enumerate(zip(shards, proofs)):
+        assert com.verify_sample(i, chunk, proof)
+    # tampered chunk, wrong index, foreign proof: all rejected
+    bad = bytes([shards[0][0] ^ 1]) + shards[0][1:]
+    assert not com.verify_sample(0, bad, proofs[0])
+    assert not com.verify_sample(1, shards[0], proofs[0])
+    assert not com.verify_sample(0, shards[0], proofs[1])
+
+
+def test_root_binds_geometry():
+    payload = rng.bytes(256)
+    _, com, _ = _commit(payload, k=4, m=4)
+    # same chunk tree, different advertised geometry -> different root
+    for twist in (
+        dataclasses.replace(com, n=com.n + 1),
+        dataclasses.replace(com, k=com.k - 1),
+        dataclasses.replace(com, payload_len=com.payload_len + 1),
+    ):
+        assert twist.root() != com.root()
+
+
+def test_reconstruct_payload_from_any_k_survivors():
+    payload = rng.bytes(1009)  # odd length exercises padding
+    shards, com, _ = _commit(payload, k=4, m=4)
+    keep = set(rng.choice(8, size=4, replace=False).tolist())
+    holes = [s if i in keep else None for i, s in enumerate(shards)]
+    assert dacommit.reconstruct_payload(holes, com) == payload
+
+
+def test_reconstruct_payload_detects_forged_survivor():
+    payload = rng.bytes(64)
+    shards, com, _ = _commit(payload, k=4, m=4)
+    holes = [None] * 4 + list(shards[4:])
+    holes[4] = bytes(len(holes[4]))  # zeroed parity shard
+    with pytest.raises(RSError):
+        dacommit.reconstruct_payload(holes, com)
+
+
+def test_empty_payload_commits():
+    shards, com, proofs = _commit(b"", k=4, m=4)
+    assert com.payload_len == 0 and len(shards) == 8
+    assert all(len(s) == 2 for s in shards)
+    assert com.verify_sample(5, shards[5], proofs[5])
+    assert dacommit.reconstruct_payload(
+        [None, None] + list(shards[2:6]) + [None, None], com
+    ) == b""
+
+
+# ------------------------------------------------------------- sampler
+
+
+def test_confidence_math():
+    # k=m=16: each sample misses a hidden-unavailable chunk with
+    # probability <= 1 - 17/32, so 7 verified samples clear 99%
+    assert dasampler.samples_for_confidence(0.99, 32, 16) == 7
+    c = dasampler.confidence_after(7, 32, 16)
+    assert c > 0.99
+    assert dasampler.confidence_after(0, 32, 16) == 0.0
+    # tighter target needs more samples, monotonic in target
+    assert dasampler.samples_for_confidence(0.9999, 32, 16) > 7
+
+
+def test_sampler_indices_deterministic_and_root_bound():
+    s1 = Sampler(client_id=3, n=32, k=16, samples=9, seed=5)
+    s2 = Sampler(client_id=3, n=32, k=16, samples=9, seed=5)
+    root = rng.bytes(32)
+    assert s1.indices(7, root) == s2.indices(7, root)
+    assert all(0 <= i < 32 for i in s1.indices(7, root))
+    # different client / height / root draw different index streams
+    s3 = Sampler(client_id=4, n=32, k=16, samples=9, seed=5)
+    assert s3.indices(7, root) != s1.indices(7, root)
+    assert s1.indices(8, root) != s1.indices(7, root)
+    assert s1.indices(7, rng.bytes(32)) != s1.indices(7, root)
+
+
+def test_sampler_run_reaches_confidence():
+    payload = rng.bytes(500)
+    shards, com, proofs = _commit(payload, k=16, m=16)
+    s = Sampler(client_id=1, n=32, k=16, confidence=0.99, seed=2)
+
+    def fetch(height, index):
+        return shards[index], proofs[index], com
+
+    res = s.run(5, com.root(), fetch)
+    assert res.confident and res.confidence > 0.99
+    assert res.samples_ok == 7 and res.samples_failed == 0
+    assert res.proof_bytes > 0
+    assert not res.detected_withholding
+
+
+def test_sampler_rejects_wrong_root_and_tampered_chunk():
+    payload = rng.bytes(500)
+    shards, com, proofs = _commit(payload, k=16, m=16)
+    s = Sampler(client_id=1, n=32, k=16, confidence=0.99, seed=2)
+    # header root disagrees with the served commitment: nothing verifies
+    res = s.run(5, rng.bytes(32), lambda h, i: (shards[i], proofs[i], com))
+    assert not res.confident and res.samples_ok == 0
+    # served chunk does not open against the commitment
+    res2 = s.run(
+        5, com.root(),
+        lambda h, i: (bytes(len(shards[i])), proofs[i], com),
+    )
+    assert not res2.confident and res2.samples_ok == 0
+
+
+def test_withholding_detected_by_client_fleet():
+    payload = rng.bytes(2048)
+    shards, com, proofs = _commit(payload, k=16, m=16)
+    withheld = set(range(17))  # m+1 chunks gone: NOT reconstructable
+
+    def fetch(height, index):
+        if index in withheld:
+            return None
+        return shards[index], proofs[index], com
+
+    detected = 0
+    for cid in range(200):
+        s = Sampler(client_id=cid, n=32, k=16, confidence=0.99, seed=9)
+        res = s.run(3, com.root(), fetch)
+        assert not res.confident or not res.failed_indices
+        if res.detected_withholding:
+            detected += 1
+    # each client misses detection with prob (15/32)^7 ~= 0.5%; 200
+    # clients all missing is astronomically unlikely — require >90%
+    assert detected > 180, detected
+
+
+# -------------------------------------------------------------- DAServe
+
+
+@pytest.fixture(scope="module")
+def chain():
+    store, state, genesis, signers = make_chain(
+        8, n_validators=3, chain_id="da-chain", backend="cpu"
+    )
+    return store, state, genesis
+
+
+def _da_serve(retain=64, k=4, m=4):
+    return DAServe(DAConfig(
+        enabled=True, data_shards=k, parity_shards=m, retain_heights=retain,
+    ))
+
+
+def test_serve_on_commit_retains_and_samples(chain):
+    store, _, _ = chain
+    srv = _da_serve()
+    for h in range(1, 9):
+        srv.on_commit(store.load_block(h))
+    st = srv.stats()
+    assert st["blocks_encoded"] == 8 and st["retained_heights"] == 8
+    blk = store.load_block(5)
+    com = srv.commitment(5)
+    assert com.root() == srv.da_root_for(blk.data)
+    fields = srv.stream_fields(5)
+    assert fields["da_root"] == com.root().hex()
+    assert fields["da_shards"] == 8 and fields["da_data_shards"] == 4
+    got = srv.sample(5, 3)
+    assert got is not None
+    chunk, proof, com2 = got
+    assert com2.verify_sample(3, chunk, proof)
+    assert srv.sample(5, 99) is None  # out of range
+    assert srv.sample(77, 0) is None  # unknown height
+    assert srv.stream_fields(77) == {}
+    # a full shard set reconstructs the committed payload
+    shards = srv.shards(5)
+    holes = [None, None, None, None] + shards[4:]
+    assert dacommit.reconstruct_payload(holes, com) == blk.data.encode()
+    srv.stop()
+
+
+def test_serve_retention_trims_oldest(chain):
+    store, _, _ = chain
+    srv = _da_serve(retain=3)
+    for h in range(1, 9):
+        srv.on_commit(store.load_block(h))
+    st = srv.stats()
+    assert st["retained_heights"] == 3
+    assert st["min_height"] == 6 and st["max_height"] == 8
+    assert srv.sample(5, 0) is None and srv.sample(8, 0) is not None
+
+
+def test_serve_withholding_hits_accounted(chain):
+    store, _, _ = chain
+    srv = _da_serve()
+    srv.on_commit(store.load_block(1))
+    srv.set_withholding(1, [0, 1])
+    assert srv.sample(1, 0) is None and srv.sample(1, 1) is None
+    assert srv.sample(1, 2) is not None
+    assert srv.stats()["withheld_hits"] == 2
+
+
+# ------------------------------------------- header + executor plumbing
+
+
+def _header(**kw):
+    base = dict(
+        chain_id="da-hdr", height=3,
+        time=Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+        validators_hash=b"\x02" * 32, proposer_address=b"\x01" * 20,
+    )
+    base.update(kw)
+    return Header(**base)
+
+
+def test_header_da_root_backcompat():
+    legacy = _header()
+    extended = _header(da_root=b"\xaa" * 32)
+    # empty root: no wire bytes, hash unchanged vs a build without the field
+    assert extended.encode() != legacy.encode()
+    assert len(extended.encode()) == len(legacy.encode()) + 34
+    assert Header.decode(legacy.encode()) == legacy
+    assert Header.decode(extended.encode()) == extended
+    assert extended.hash() != legacy.hash()
+    assert Header.decode(legacy.encode()).hash() == legacy.hash()
+
+
+def test_validate_block_rejects_bad_da_root_length(chain):
+    from cometbft_tpu.state.execution import BlockValidationError, validate_block
+
+    store, _, genesis = chain
+    blk = store.load_block(1)  # initial block validates against genesis
+    validate_block(genesis, blk, backend="cpu")
+    for bad_len in (31, 33, 1):
+        bad = dataclasses.replace(
+            blk,
+            header=dataclasses.replace(blk.header, da_root=b"\xaa" * bad_len),
+        )
+        with pytest.raises(BlockValidationError, match="da_root"):
+            validate_block(genesis, bad, backend="cpu")
+    # a well-formed 32-byte root passes the shape gate
+    ok = dataclasses.replace(
+        blk, header=dataclasses.replace(blk.header, da_root=b"\xaa" * 32)
+    )
+    validate_block(genesis, ok, backend="cpu")
+
+
+def test_executor_da_commitment_check(chain):
+    from cometbft_tpu.state.execution import (
+        BlockExecutor,
+        BlockValidationError,
+    )
+
+    store, _, _ = chain
+    srv = _da_serve()
+    ex = BlockExecutor(None, backend="cpu")
+    ex.da_encoder = srv
+    blk = store.load_block(4)
+    good = dataclasses.replace(
+        blk,
+        header=dataclasses.replace(
+            blk.header, da_root=srv.da_root_for(blk.data)
+        ),
+    )
+    ex.check_da_commitment(good)  # passes
+    with pytest.raises(BlockValidationError, match="missing da_root"):
+        ex.check_da_commitment(blk)  # chain was built without DA
+    forged = dataclasses.replace(
+        blk, header=dataclasses.replace(blk.header, da_root=b"\xbb" * 32)
+    )
+    with pytest.raises(BlockValidationError, match="wrong da_root"):
+        ex.check_da_commitment(forged)
+    # without an encoder the gate is inert
+    ex.da_encoder = None
+    ex.check_da_commitment(forged)
+
+
+def test_header_json_roundtrip_carries_da_root():
+    from cometbft_tpu.rpc.codec import header_from_json
+    from cometbft_tpu.rpc.routes import _header_json
+
+    h = _header(da_root=b"\xcd" * 32)
+    back = header_from_json(_header_json(h))
+    assert back.da_root == h.da_root and back.hash() == h.hash()
+
+
+# ---------------------------------------------------------- [da] config
+
+
+def test_da_config_validation():
+    DAConfig().validate()
+    DAConfig(enabled=True, data_shards=1, parity_shards=1).validate()
+    for bad in (
+        DAConfig(data_shards=0),
+        DAConfig(parity_shards=0),
+        DAConfig(data_shards=4000, parity_shards=200),
+        DAConfig(samples_per_client=-1),
+        DAConfig(confidence=0.0),
+        DAConfig(confidence=1.0),
+        DAConfig(retain_heights=0),
+    ):
+        with pytest.raises(ValueError):
+            bad.validate()
+
+
+def test_da_config_toml_roundtrip(tmp_path):
+    cfg = Config()
+    cfg.da.enabled = True
+    cfg.da.data_shards = 32
+    cfg.da.confidence = 0.999
+    path = str(tmp_path / "config.toml")
+    cfg.save(path)
+    back = Config.load(path)
+    assert back.da.enabled and back.da.data_shards == 32
+    assert back.da.confidence == 0.999
+
+
+# ----------------------------------------------------------- RPC routes
+
+
+def test_da_routes_disabled_without_serve():
+    client = LocalClient(Env())
+    for call in (lambda: client.da_status(),
+                 lambda: client.da_sample(height="3", index="0")):
+        with pytest.raises(RPCError, match="disabled"):
+            call()
+
+
+def test_da_routes(chain):
+    store, _, _ = chain
+    srv = _da_serve()
+    for h in range(1, 5):
+        srv.on_commit(store.load_block(h))
+    client = LocalClient(Env(da_serve=srv))
+    st = client.da_status()
+    assert st["enabled"] and st["blocks_encoded"] == 4
+    assert st["min_height"] == "1" and st["max_height"] == "4"
+    r = client.da_sample(height="2", index="5")
+    com = srv.commitment(2)
+    assert r["commitment"]["da_root"] == com.root().hex().upper()
+    assert bytes.fromhex(r["chunk"]) == srv.shards(2)[5]
+    with pytest.raises(RPCError, match="no sample"):
+        client.da_sample(height="2", index="44")
+
+
+# ----------------------------------- dump_consensus_state consistency
+
+
+def test_dump_consensus_state_consistent_during_height_transitions(tmp_path):
+    """Hammer the dump routes while a live single-validator chain moves
+    through heights: every snapshot must be internally consistent (the
+    rs_mutex guarantees the consensus thread is between _process
+    transitions), and the round-state invariants the old retry
+    heuristic could see torn — votes tracking a different height than
+    the round state — must hold whenever the lock is held."""
+    from cometbft_tpu.consensus.net import InProcessNetwork
+
+    net = InProcessNetwork(1, str(tmp_path))
+    net.start()
+    stop = threading.Event()
+    errors = []
+    snapshots = []
+
+    def hammer():
+        cs = net.nodes[0].cs
+        client = LocalClient(Env(consensus=cs))
+        last_h = 0
+        try:
+            while not stop.is_set():
+                r = client.dump_consensus_state()
+                rs_ = r["round_state"]
+                h = int(rs_["height"])
+                assert h >= last_h, (h, last_h)
+                last_h = h
+                assert rs_["round"] >= 0 and rs_["step"] >= 0
+                snapshots.append(h)
+                with cs.rs_mutex:
+                    # the invariant a torn read can violate: the vote
+                    # sets always belong to the current height
+                    assert cs.votes.height == cs.height
+                client.consensus_state()
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        assert net.wait_for_height(6, timeout=60), "1-val net stalled"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        net.stop()
+    assert not errors, errors[0]
+    assert snapshots and max(snapshots) >= 2
+
+
+def test_rs_mutex_blocks_round_state_transitions(tmp_path):
+    """Holding rs_mutex freezes consensus between transitions: height
+    cannot advance while an RPC snapshot is being taken, and resumes
+    after release."""
+    from cometbft_tpu.consensus.net import InProcessNetwork
+
+    net = InProcessNetwork(1, str(tmp_path))
+    net.start()
+    try:
+        assert net.wait_for_height(2, timeout=30)
+        cs = net.nodes[0].cs
+        with cs.rs_mutex:
+            h0, r0, s0 = cs.height, cs.round, int(cs.step)
+            time.sleep(0.6)  # several commit intervals
+            assert (cs.height, cs.round, int(cs.step)) == (h0, r0, s0)
+        assert net.wait_for_height(h0 + 2, timeout=30)
+    finally:
+        net.stop()
+
+
+# ------------------------------------------------- full-node integration
+
+
+def test_node_da_end_to_end(tmp_path):
+    """Single-validator node with [da] on: every committed header
+    carries the DAServe-derived da_root, the RPC surface serves
+    verifiable samples, a sampling client reaches confidence against
+    the in-process transport, and /light_stream payloads advertise the
+    DA geometry."""
+    import json as _json
+    import os
+
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = str(tmp_path)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.generate(None, None)
+    GenesisDoc(
+        chain_id="da-node-chain",
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[GenesisValidator(pv.pub_key().bytes(), 10, "v0")],
+    ).save(os.path.join(home, "config/genesis.json"))
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        _json.dump({
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }, f)
+
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_propose = 0.6
+    cfg.consensus.timeout_propose_delta = 0.2
+    cfg.consensus.timeout_prevote = 0.3
+    cfg.consensus.timeout_prevote_delta = 0.1
+    cfg.consensus.timeout_precommit = 0.3
+    cfg.consensus.timeout_precommit_delta = 0.1
+    cfg.consensus.timeout_commit = 0.05
+    cfg.light.serve = True
+    cfg.light.persist_mmr = False
+    cfg.da.enabled = True
+    cfg.da.data_shards = 8
+    cfg.da.parity_shards = 8
+    node = Node(cfg, app=KVStoreApp())
+    node.start()
+    try:
+        client = LocalClient(node.rpc_env)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if node.consensus.sm_state.last_block_height >= 4:
+                break
+            try:
+                client.broadcast_tx_sync(tx=b"da=1".hex())
+            except Exception:  # noqa: BLE001 — mempool dup/full
+                pass
+            time.sleep(0.05)
+        h = node.consensus.sm_state.last_block_height
+        assert h >= 4, f"node stalled at {h}"
+
+        srv = node.da_serve
+        assert srv is not None and node.executor.da_encoder is srv
+
+        # every committed header commits to its own payload's extension
+        for hh in range(1, h + 1):
+            blk = node.block_store.load_block(hh)
+            assert len(blk.header.da_root) == 32
+            assert blk.header.da_root == srv.da_root_for(blk.data)
+
+        # RPC surface: status + one verified sample
+        st = client.da_status()
+        assert st["enabled"] and st["blocks_encoded"] >= h
+        r = client.da_sample(height=str(h), index="0")
+        com = srv.commitment(h)
+        assert r["commitment"]["da_root"] == com.root().hex().upper()
+
+        # a sampling client over the in-process transport
+        s = Sampler(client_id=7, n=16, k=8, confidence=0.99, seed=0)
+        res = s.run(h, com.root(), srv.sample)
+        assert res.confident and not res.detected_withholding
+
+        # withholding at the tip is observable
+        srv.set_withholding(h, range(9))
+        res2 = Sampler(client_id=8, n=16, k=8, seed=0).run(
+            h, com.root(), srv.sample)
+        assert res2.detected_withholding
+
+        # /light_stream payloads advertise the DA geometry
+        fields = node.light_serve.da_serve.stream_fields(h)
+        assert fields["da_root"] == com.root().hex()
+        assert fields["da_shards"] == 16 and fields["da_data_shards"] == 8
+    finally:
+        node.stop()

@@ -7,9 +7,12 @@
 # plus the secp256k1 verify engine (r/s boundary values, bad point
 # encodings, multi-verify chunk determinism), the sr25519 unit
 # (ristretto decode rejects, merlin challenge, batch residue s >= L,
-# n==0 batches), and the BLS12-381 pairing engine (PoP cycle,
+# n==0 batches), the BLS12-381 pairing engine (PoP cycle,
 # identity-point rejection, n==0 aggregates, 128-key max-size
-# aggregation chunk determinism, single cert pairing check).
+# aggregation chunk determinism, single cert pairing check), and the
+# GF(2^16) Reed-Solomon DA codec (parameter guards, insufficient
+# survivors, 4096-shard ceiling, threaded encode/reconstruct roundtrip
+# with chunk-count determinism).
 set -e
 cd "$(dirname "$0")/.."
 # -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults

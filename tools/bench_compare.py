@@ -316,6 +316,119 @@ def compare_bls(ref: str, threshold: float,
     }
 
 
+def _das_record(flat_src: str):
+    """The das_sampling_* record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        for key, rec in data.items():
+            if key.startswith("das_sampling_") and isinstance(rec, dict):
+                return rec
+    return None
+
+
+# polarity the suffix heuristics would get wrong (or miss): per-sample
+# wire bytes LOOK like a "per_s" throughput key but are a cost, and the
+# MB/s codec rates carry no recognized suffix at all
+_DAS_DIRECTIONS = {
+    "honest.proof_bytes_per_sample": "lower",
+    "codec.native_mb_s": "higher",
+    "codec.oracle_mb_s": "higher",
+}
+# noisy / non-measurement leaves: per-leg snapshots, run geometry,
+# counters that scale with wall time rather than efficiency
+_DAS_SKIP = ("honest_legs.", "withholding.", "gate.", "http_", "heights_",
+             "blocks_encoded", "samples_served", "withheld_hits",
+             "duration_s", "data_shards", "parity_shards",
+             "honest.clients", "honest.samples_total",
+             "honest.proof_bytes_bound", "honest.clients_confident",
+             "codec.payload_bytes", "codec.rs_threads")
+
+
+def compare_das(ref: str, threshold: float,
+                relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the data-availability sampling workload (ISSUE 14):
+    fleet verify throughput, per-sample wire cost, and the native codec
+    rates go through the directional machinery (with explicit polarity
+    for the keys the suffix heuristics would misread); the withholding
+    detection fraction is first-class — it dropping is the regression
+    the adversarial leg exists to catch."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _das_record(f.read())
+    base = _das_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no das_sampling record on one side"}
+
+    b_flat, c_flat = _flatten(base), _flatten(cur)
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p in key for p in _DAS_SKIP):
+            continue
+        d = _DAS_DIRECTIONS.get(key) or direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    def frac(rec):
+        adv = rec.get("withholding") or {}
+        n = adv.get("clients") or 0
+        return (adv.get("clients_detected_withholding", 0) / n) if n else None
+
+    b_f, c_f = frac(base), frac(cur)
+    detect = {"baseline": b_f, "current": c_f,
+              "worse": (b_f is not None and c_f is not None
+                        and c_f < b_f - 0.02),
+              "better": (b_f is not None and c_f is not None
+                         and c_f > b_f + 0.02)}
+    regs = [r for r in rows if r["worse"]]
+    if detect["worse"]:
+        regs.append({"key": "withholding_detect_frac", **detect})
+    return {
+        "file": relpath, "mode": "das_sampling",
+        "withholding_detect": detect,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_das(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"das sampling: skipped ({rep['skipped']})")
+        return
+    d = rep["withholding_detect"]
+    tag = ("REGRESSION" if d["worse"]
+           else "improved  " if d["better"] else "          ")
+    b = f"{d['baseline']:.1%}" if d["baseline"] is not None else "n/a"
+    c = f"{d['current']:.1%}" if d["current"] is not None else "n/a"
+    print(f"das sampling ({rep['file']}): {tag} withholding detected by "
+          f"{b} -> {c} of the fleet")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-32s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_bls(rep: dict) -> None:
     if "skipped" in rep:
         print(f"bls crossover: skipped ({rep['skipped']})")
@@ -367,6 +480,10 @@ def main(argv=None) -> int:
                     help="also diff the ed25519-vs-BLS crossover table "
                          "point-by-point (the crossover validator count "
                          "first-class)")
+    ap.add_argument("--das", action="store_true",
+                    help="also diff the data-availability sampling "
+                         "workload (withholding detection fraction "
+                         "first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -384,8 +501,10 @@ def main(argv=None) -> int:
                   if args.ingest else None)
     bls_rep = (compare_bls(args.ref, args.threshold)
                if args.bls else None)
+    das_rep = (compare_das(args.ref, args.threshold)
+               if args.das else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    for extra in (ingest_rep, bls_rep):
+    for extra in (ingest_rep, bls_rep, das_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -395,6 +514,8 @@ def main(argv=None) -> int:
         summary["ingest_waterfall"] = ingest_rep
     if bls_rep is not None:
         summary["bls_crossover"] = bls_rep
+    if das_rep is not None:
+        summary["das_sampling"] = das_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -418,6 +539,8 @@ def main(argv=None) -> int:
             _print_ingest(ingest_rep)
         if bls_rep is not None:
             _print_bls(bls_rep)
+        if das_rep is not None:
+            _print_das(das_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "

@@ -1,0 +1,332 @@
+#!/usr/bin/env python3
+"""Data-availability sampling fleet driver (ROADMAP item #3, ISSUE 14).
+
+Boots one in-process validator with DA encoding on (`[da] enabled =
+true`) and drives a large sampling-client population against its
+serving surface:
+
+- a tx producer keeps non-empty blocks committing, each one
+  erasure-coded (k data + m parity shards over GF(2^16)) and committed
+  to in the header's da_root at proposal time;
+- per committed height, N da/sampler.py clients (default 1000) draw
+  seeded random chunk indices and verify each opening proof against
+  the header root — the in-process `DAServe.sample` transport, i.e.
+  the same object the `da_sample` RPC route calls;
+- a handful of REAL HTTP `da_sample` requests prove the wire path
+  (hex/b64 decode + client-side proof verification);
+- an adversarial leg re-runs the fleet against a height with m+1
+  chunks withheld (the minimum unrecoverable suppression): clients
+  must fail samples and NOT reach confidence;
+- the native GF(2^16) codec is timed against the numpy oracle on a
+  proposal-sized payload (same parity, differentially checked here).
+
+Emits one JSON object on stdout; tools/workloads.py wraps it as the
+machine-gated `das_sampling_1000c` workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build_node(home: str, k: int, m: int):
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types import Timestamp
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.generate(None, None)
+    genesis = GenesisDoc(
+        chain_id="dasload-chain",
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[GenesisValidator(pv.pub_key().bytes(), 10, "v0")],
+    )
+    genesis.save(os.path.join(home, "config/genesis.json"))
+    with open(os.path.join(home, "config/priv_validator_key.json"), "w") as f:
+        json.dump({
+            "address": pv.pub_key().address().hex(),
+            "pub_key": pv.pub_key().bytes().hex(),
+            "priv_key": pv._priv.bytes().hex(),
+        }, f)
+
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = "dasload"
+    cfg.base.db_backend = "mem"
+    cfg.base.crypto_backend = "cpu"  # 1 validator: batching buys nothing
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"  # real HTTP for da_sample
+    cfg.consensus.timeout_propose = 0.6
+    cfg.consensus.timeout_propose_delta = 0.2
+    cfg.consensus.timeout_prevote = 0.3
+    cfg.consensus.timeout_prevote_delta = 0.1
+    cfg.consensus.timeout_precommit = 0.3
+    cfg.consensus.timeout_precommit_delta = 0.1
+    cfg.consensus.timeout_commit = 0.05
+    cfg.light.serve = True  # /light_stream carries the da_* fields
+    cfg.light.persist_mmr = False
+    cfg.da.enabled = True
+    cfg.da.data_shards = k
+    cfg.da.parity_shards = m
+    return Node(cfg, app=KVStoreApp())
+
+
+def _http_sample(host, port, height, index, da_root):
+    """One da_sample over real HTTP, proof verified client-side."""
+    import base64
+
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.da.commit import DACommitment
+
+    url = (f"http://{host}:{port}/da_sample"
+           f"?height={height}&index={index}")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        r = json.loads(resp.read())["result"]
+    chunk = bytes.fromhex(r["chunk"])
+    pr = r["proof"]
+    proof = merkle.Proof(
+        total=int(pr["total"]), index=int(pr["index"]),
+        leaf_hash=base64.b64decode(pr["leaf_hash"]),
+        aunts=[base64.b64decode(a) for a in pr["aunts"]],
+    )
+    cm = r["commitment"]
+    com = DACommitment(
+        n=int(cm["shards"]), k=int(cm["data_shards"]),
+        payload_len=int(cm["payload_len"]),
+        chunks_root=bytes.fromhex(cm["chunks_root"]),
+    )
+    ok = (com.root() == da_root
+          and com.verify_sample(int(r["index"]), chunk, proof))
+    return ok
+
+
+def _bench_codec(k: int, m: int, payload_bytes: int) -> dict:
+    """Native vs oracle encode on one proposal-sized payload; parity
+    must be byte-identical (the fleet leg already trusts dispatch —
+    this pins the differential in the workload record too)."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import native
+    from cometbft_tpu.da import rs
+    from cometbft_tpu.da.commit import split_payload
+
+    payload = np.random.default_rng(7).bytes(payload_bytes)
+    data = split_payload(payload, k)
+    t0 = time.perf_counter()
+    oracle = rs.encode_oracle(data, m)
+    t_oracle = time.perf_counter() - t0
+    out = {
+        "payload_bytes": payload_bytes,
+        "oracle_encode_ms": round(t_oracle * 1e3, 2),
+        "oracle_mb_s": round(payload_bytes / t_oracle / 1e6, 1),
+        "native_available": native.rs_available(),
+        "rs_threads": native.rs_threads(),
+    }
+    if native.rs_available():
+        sl = len(data[0])
+        blob = b"".join(data)
+        native.rs_encode(blob, k, m, sl)  # warmup (table build)
+        t0 = time.perf_counter()
+        nat = native.rs_encode(blob, k, m, sl)
+        t_native = time.perf_counter() - t0
+        assert nat == b"".join(oracle), "native parity != oracle parity"
+        out["native_encode_ms"] = round(t_native * 1e3, 2)
+        out["native_mb_s"] = round(payload_bytes / t_native / 1e6, 1)
+        out["native_speedup"] = round(t_oracle / t_native, 2)
+    return out
+
+
+def run(clients: int, duration_s: float, k: int, m: int,
+        http_samples: int, codec_mb: float) -> dict:
+    home = tempfile.mkdtemp(prefix="dasload-")
+    node = _build_node(home, k, m)
+    from cometbft_tpu.da.sampler import Sampler
+    from cometbft_tpu.rpc.client import LocalClient
+
+    node.start()
+    srv = node.da_serve
+    rpc_host, rpc_port = node.rpc_addr
+    stop = threading.Event()
+
+    def producer():
+        client = LocalClient(node.rpc_env)
+        seq = 0
+        while not stop.is_set():
+            try:
+                client.broadcast_tx_sync(
+                    tx=f"das{seq}={'x' * 64}".encode().hex())
+            except Exception:  # noqa: BLE001 — pool full: back off
+                stop.wait(0.05)
+            seq += 1
+            stop.wait(0.005)
+
+    # one reusable fleet: seeded draws differ per (client, height, root)
+    fleet = [Sampler(client_id=i, n=k + m, k=k, confidence=0.99, seed=1)
+             for i in range(clients)]
+
+    def run_fleet(height: int, da_root: bytes) -> dict:
+        confident = 0
+        failed_clients = 0
+        samples_ok = 0
+        samples_failed = 0
+        proof_bytes = 0
+        t0 = time.perf_counter()
+        for s in fleet:
+            res = s.run(height, da_root, srv.sample)
+            samples_ok += res.samples_ok
+            samples_failed += res.samples_failed
+            proof_bytes += res.proof_bytes
+            if res.confident:
+                confident += 1
+            if res.detected_withholding:
+                failed_clients += 1
+        dt = time.perf_counter() - t0
+        total = samples_ok + samples_failed
+        return {
+            "clients": len(fleet),
+            "clients_confident": confident,
+            "clients_detected_withholding": failed_clients,
+            "samples": total,
+            "samples_ok": samples_ok,
+            "samples_per_sec": round(total / dt, 1) if dt else 0.0,
+            "proof_bytes_per_sample": (
+                round(proof_bytes / samples_ok, 1) if samples_ok else 0.0),
+            "fleet_s": round(dt, 3),
+        }
+
+    t_prod = threading.Thread(target=producer, daemon=True)
+    t_start = time.perf_counter()
+    start_height = node.consensus.sm_state.last_block_height
+    t_prod.start()
+
+    # honest legs: sample every freshly committed height until the
+    # duration budget is spent
+    honest_legs = []
+    last_sampled = 0
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        st = srv.stats()
+        h = st["max_height"]
+        if h and h > last_sampled:
+            com = srv.commitment(h)
+            if com is None:  # trimmed mid-race
+                continue
+            leg = run_fleet(h, com.root())
+            leg["height"] = h
+            # per-sample wire bound: one chunk + the Merkle path
+            # (leaf hash + ceil(log2 n) aunts) + the 12-byte header
+            leg["chunk_bytes"] = 2 * max(1, -(-com.payload_len // (2 * k)))
+            leg["proof_bytes_bound"] = (
+                leg["chunk_bytes"] + 32 * (1 + (k + m - 1).bit_length()) + 12)
+            honest_legs.append(leg)
+            last_sampled = h
+        else:
+            time.sleep(0.02)
+
+    # wire leg: a handful of REAL HTTP da_sample fetches
+    http_ok = 0
+    http_errors = []
+    wire_h = last_sampled
+    wire_root = srv.commitment(wire_h).root() if wire_h else b""
+    for i in range(http_samples):
+        try:
+            if _http_sample(rpc_host, rpc_port, wire_h, i % (k + m),
+                            wire_root):
+                http_ok += 1
+            else:
+                http_errors.append(f"sample {i}: proof failed")
+        except Exception as e:  # noqa: BLE001 — record, gate below
+            http_errors.append(f"sample {i}: {e}")
+
+    # adversarial leg: withhold m+1 chunks of the latest height — the
+    # minimum suppression that makes the payload unrecoverable — and
+    # re-run the fleet. Detection is probabilistic per client (each
+    # sample hits a withheld chunk with prob > (m+1)/n), so the gate is
+    # on the detecting FRACTION, not unanimity.
+    adv_h = last_sampled
+    srv.set_withholding(adv_h, range(m + 1))
+    adv = run_fleet(adv_h, srv.commitment(adv_h).root())
+    adv["height"] = adv_h
+    adv["withheld_chunks"] = m + 1
+
+    stop.set()
+    t_prod.join(timeout=5)
+    t_load = time.perf_counter() - t_start
+    end_height = node.consensus.sm_state.last_block_height
+    stats = srv.stats()
+    header_root = node.block_store.load_block(adv_h).header.da_root
+    node.stop()
+    shutil.rmtree(home, ignore_errors=True)
+
+    codec = _bench_codec(k, m, int(codec_mb * 1e6))
+
+    heights = end_height - start_height
+    agg = {
+        "clients": clients,
+        "heights_sampled": len(honest_legs),
+        "clients_confident_min": min(
+            (l["clients_confident"] for l in honest_legs), default=0),
+        "samples_total": sum(l["samples"] for l in honest_legs),
+        "samples_per_sec": round(
+            sum(l["samples_per_sec"] for l in honest_legs)
+            / max(1, len(honest_legs)), 1),
+        "proof_bytes_per_sample": max(
+            (l["proof_bytes_per_sample"] for l in honest_legs), default=0.0),
+        "proof_bytes_bound": max(
+            (l["proof_bytes_bound"] for l in honest_legs), default=0),
+    }
+    return {
+        "metric": "das_sampling_1000c",
+        "data_shards": k,
+        "parity_shards": m,
+        "duration_s": round(t_load, 2),
+        "heights_committed": heights,
+        "header_da_root": header_root.hex(),
+        "honest": agg,
+        "honest_legs": honest_legs[:3],
+        "withholding": adv,
+        "http_samples_ok": http_ok,
+        "http_samples": http_samples,
+        "http_errors": http_errors[:5],
+        "blocks_encoded": stats["blocks_encoded"],
+        "samples_served": stats["samples_served"],
+        "withheld_hits": stats["withheld_hits"],
+        "codec": codec,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=1000,
+                    help="sampling clients per committed block")
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--data-shards", type=int, default=16)
+    ap.add_argument("--parity-shards", type=int, default=16)
+    ap.add_argument("--http-samples", type=int, default=8,
+                    help="real HTTP da_sample fetches")
+    ap.add_argument("--codec-mb", type=float, default=4.0,
+                    help="payload MB for the native-vs-oracle encode leg")
+    args = ap.parse_args()
+    res = run(args.clients, args.duration, args.data_shards,
+              args.parity_shards, args.http_samples, args.codec_mb)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

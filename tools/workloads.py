@@ -11,6 +11,11 @@ Three production shapes (SURVEY §3.3 / BASELINE configs):
 
 Run: python tools/workloads.py [--quick]
 Each metric prints one JSON line; all are written to WORKLOADS.json.
+
+Separate flags run the heavier subsystem workloads on their own:
+--ingest, --light (10k-subscriber /light_stream fan-out), --bls
+(aggregate-signature certificate track), --das (data-availability
+sampling fleet + withholding leg), --multichip, --two-backend.
 """
 
 from __future__ import annotations
@@ -1050,6 +1055,117 @@ def bench_light_stream_fanout(clients=10000, duration_s=10.0, workers=8,
     return rec
 
 
+def bench_das_fleet(clients=1000, duration_s=8.0, k=16, m=16,
+                    http_samples=8):
+    """Data-availability sampling workload (ROADMAP item #3, ISSUE 14):
+    tools/dasload.py boots one DA-encoding validator and drives
+    `clients` sampling clients per committed block against its serving
+    surface, plus an adversarial withholding leg and a native-vs-oracle
+    GF(2^16) encode comparison.
+
+    Two gate classes:
+
+    - asserted EVERYWHERE (protocol correctness, not host speed): every
+      client of every honest leg reaches 99% confidence, each sample's
+      wire cost stays within chunk + Merkle-path bound, the HTTP
+      da_sample path verifies client-side, the header carries a 32-byte
+      da_root, and with m+1 chunks withheld >= 95% of clients detect it
+      (each client misses with prob < 0.5%);
+    - machine-gated on >=2 cores: fleet sample-verify throughput and
+      the native codec's speedup over the numpy oracle (both time-share
+      the core with consensus on a starved host).
+    """
+    import subprocess
+
+    n_clients = 200 if QUICK else clients
+    dur = 4.0 if QUICK else duration_s
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dasload.py")
+    p = subprocess.run(
+        [sys.executable, script, "--clients", str(n_clients),
+         "--duration", str(dur), "--data-shards", str(k),
+         "--parity-shards", str(m), "--http-samples", str(http_samples)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"dasload rc={p.returncode}\nstderr: {p.stderr[-2000:]}")
+    rec = None
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if rec is None:
+        raise RuntimeError(f"dasload produced no JSON: {p.stdout[-500:]}")
+    hon, adv, codec = rec["honest"], rec["withholding"], rec["codec"]
+    print(f"  das fleet: {hon['clients']} clients x "
+          f"{hon['heights_sampled']} heights, "
+          f"{hon['samples_per_sec']} samples/s, "
+          f"{hon['proof_bytes_per_sample']} B/sample, withholding "
+          f"detected by {adv['clients_detected_withholding']}"
+          f"/{adv['clients']}, native codec "
+          f"{codec.get('native_speedup', 'n/a')}x oracle", file=sys.stderr)
+
+    # --- correctness gates: asserted unconditionally -------------------
+    assert rec["heights_committed"] > 0 and hon["heights_sampled"] > 0, (
+        "no blocks committed/sampled under the DA fleet")
+    assert hon["clients_confident_min"] == hon["clients"], (
+        f"only {hon['clients_confident_min']}/{hon['clients']} clients "
+        "reached 99% confidence on a fully-available block"
+    )
+    assert hon["proof_bytes_per_sample"] <= hon["proof_bytes_bound"], (
+        f"per-sample wire cost {hon['proof_bytes_per_sample']} B exceeds "
+        f"the chunk+path bound {hon['proof_bytes_bound']} B"
+    )
+    assert (rec["http_samples_ok"] == rec["http_samples"]
+            and not rec["http_errors"]), (
+        f"HTTP da_sample path failed: {rec['http_errors']}")
+    assert len(rec["header_da_root"]) == 64, (
+        f"committed header carries no 32-byte da_root: "
+        f"{rec['header_da_root']!r}")
+    detect_frac = adv["clients_detected_withholding"] / adv["clients"]
+    assert detect_frac >= 0.95, (
+        f"only {detect_frac:.1%} of clients detected {adv['withheld_chunks']}"
+        f"/{k + m} chunks withheld (expected >= 95%)"
+    )
+    assert codec["native_available"], "native GF(2^16) codec not built"
+
+    # --- throughput gates: machine-gated -------------------------------
+    gate = {
+        "all_clients_confident": True,
+        "proof_bytes_within_bound": True,
+        "http_samples_verified": True,
+        "min_withholding_detect_frac": 0.95,
+        "min_samples_per_sec": 2000.0,
+        "min_native_codec_speedup": 1.5,
+    }
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        gate["asserted"] = False
+        gate["reason"] = (
+            f"starved host: {cores} core(s) — the sampling fleet, the "
+            "RS worker pool, and consensus time-share the core, so "
+            "throughput/speedup thresholds would gate on scheduler "
+            "interleaving; correctness gates above asserted anyway. "
+            "Re-run `python tools/workloads.py --das` on a >=2-core host"
+        )
+    else:
+        gate["asserted"] = True
+        assert hon["samples_per_sec"] >= gate["min_samples_per_sec"], (
+            f"{hon['samples_per_sec']} samples/s < "
+            f"{gate['min_samples_per_sec']}"
+        )
+        assert codec["native_speedup"] >= gate["min_native_codec_speedup"], (
+            f"native codec only {codec['native_speedup']}x oracle < "
+            f"{gate['min_native_codec_speedup']}x"
+        )
+    rec["gate"] = gate
+    return rec
+
+
 def main():
     if "--multichip-child" in sys.argv:
         i = sys.argv.index("--multichip-child")
@@ -1080,6 +1196,11 @@ def main():
         return
     if "--bls" in sys.argv:
         rec = bench_megacommit_bls()
+        _emit(rec)
+        _merge_workloads([rec])
+        return
+    if "--das" in sys.argv:
+        rec = bench_das_fleet()
         _emit(rec)
         _merge_workloads([rec])
         return
