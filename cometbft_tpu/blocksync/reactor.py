@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 
+from ..crypto.sched import verify_context
 from ..encoding import proto as pb
 from ..p2p.conn import ChannelDescriptor
 from ..p2p.switch import Reactor
@@ -61,6 +62,8 @@ class BlockSyncReactor(Reactor):
         self.executor = executor
         self.state = state
         self.backend = backend
+        self.sched = None  # shared VerifyScheduler (crypto/sched.py)
+        self.tenant = ""
         self.pool: BlockPool | None = None
         self._peers: dict[str, object] = {}
         self._lock = threading.Lock()
@@ -158,14 +161,15 @@ class BlockSyncReactor(Reactor):
             try:
                 # block H is endorsed by H+1's LastCommit — the batch
                 # verify hot path (reference reactor.go:462)
-                verify_commit_light(
-                    state.chain_id,
-                    state.validators,
-                    bid,
-                    first.header.height,
-                    second.last_commit,
-                    backend=self.backend,
-                )
+                with verify_context(self.sched, self.tenant, "blocksync"):
+                    verify_commit_light(
+                        state.chain_id,
+                        state.validators,
+                        bid,
+                        first.header.height,
+                        second.last_commit,
+                        backend=self.backend,
+                    )
             except CommitError as e:
                 bad = self.pool.redo_request(first.header.height)
                 m.bad_blocks_total.inc()

@@ -61,6 +61,8 @@ class ReplayEngine:
         window: int = 64,
         backend: str = "tpu",
         depth: int | None = None,
+        sched=None,
+        tenant: str = "",
     ):
         # window=64 default: each window resolve pays one device->host
         # round trip (~100 ms on a tunneled runtime), so fewer, larger
@@ -75,6 +77,10 @@ class ReplayEngine:
         self.backend = backend
         # in-flight window count: None = auto (see _pipeline_depth)
         self.depth = depth
+        # optional crypto.sched.VerifyScheduler: window mega-batches
+        # coalesce with other consumers' work at blocksync priority
+        self.sched = sched
+        self.tenant = tenant
 
     def _pipeline_depth(self) -> int:
         """Windows in flight at once. Single device: 2 (device verifies
@@ -270,7 +276,12 @@ class ReplayEngine:
         if commit is None:
             raise BlockValidationError(f"missing commit at height {tip}")
         queue_commit(commit, validators, prev_bid, tip, all_sigs=False)
-        return bv.submit(), per_commit, lane + singles
+        if self.sched is not None:
+            pending = self.sched.submit(
+                bv, tenant=self.tenant, source="blocksync")
+        else:
+            pending = bv.submit()
+        return pending, per_commit, lane + singles
 
     def _light_check_window(self, state, blocks: list) -> int:
         """Synchronous window check (submit + resolve); kept for callers
@@ -394,6 +405,7 @@ class ReplayEngine:
             stats.elapsed_s = time.perf_counter() - t0
             return state, stats
         # "full" mode: reference-faithful per-height verify + apply
+        from ..crypto.sched import verify_context
         from ..types.validation import verify_commit_light
 
         while h <= tip:
@@ -402,10 +414,11 @@ class ReplayEngine:
             if block is None or commit is None:
                 raise BlockValidationError(f"missing block/commit at {h}")
             bid = block_id_for(block)
-            verify_commit_light(
-                state.chain_id, state.validators, bid, h, commit,
-                backend=self.backend,
-            )
+            with verify_context(self.sched, self.tenant, "blocksync"):
+                verify_commit_light(
+                    state.chain_id, state.validators, bid, h, commit,
+                    backend=self.backend,
+                )
             stats.sigs_verified += sum(
                 1 for cs in commit.signatures if cs.is_commit()
             )

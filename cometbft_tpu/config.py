@@ -303,6 +303,38 @@ class DAConfig:
 
 
 @dataclass
+class SchedConfig:
+    """Shared verification scheduler (crypto/sched.py, ROADMAP #4).
+
+    When `enabled`, every verify consumer on the node — consensus
+    commit checks, blocksync replay windows, light-serve cache misses,
+    mempool admission sig windows — submits its filled batch verifier
+    to one process-wide scheduler (keyed by crypto backend) instead of
+    dispatching directly. The scheduler coalesces concurrent requests
+    into mega-batches bounded by `max_coalesce_sigs` /
+    `max_coalesce_delay_ms` and services tenants (chain_ids) by
+    deficit-round-robin weighted by `tenant_weight`. A lone request
+    passes straight through with no added latency."""
+
+    enabled: bool = True
+    max_coalesce_sigs: int = 16384
+    max_coalesce_delay_ms: float = 2.0
+    stop_timeout_s: float = 2.0
+    # this node's DRR weight when several chains share the scheduler
+    tenant_weight: float = 1.0
+
+    def validate(self) -> None:
+        if self.max_coalesce_sigs < 1:
+            raise ValueError("sched.max_coalesce_sigs must be >= 1")
+        if self.max_coalesce_delay_ms < 0:
+            raise ValueError("sched.max_coalesce_delay_ms must be >= 0")
+        if self.stop_timeout_s <= 0:
+            raise ValueError("sched.stop_timeout_s must be positive")
+        if self.tenant_weight <= 0:
+            raise ValueError("sched.tenant_weight must be positive")
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -351,6 +383,7 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     light: LightConfig = field(default_factory=LightConfig)
     da: DAConfig = field(default_factory=DAConfig)
+    sched: SchedConfig = field(default_factory=SchedConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
@@ -358,7 +391,8 @@ class Config:
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
-                        self.light, self.da, self.instrumentation):
+                        self.light, self.da, self.sched,
+                        self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------
@@ -400,6 +434,7 @@ class Config:
             emit("storage", self.storage),
             emit("light", self.light),
             emit("da", self.da),
+            emit("sched", self.sched),
             emit("instrumentation", self.instrumentation),
         ]
         return "\n\n".join(parts) + "\n"
@@ -439,6 +474,7 @@ class Config:
             storage=mk(StorageConfig, d.get("storage", {})),
             light=mk(LightConfig, d.get("light", {})),
             da=mk(DAConfig, d.get("da", {})),
+            sched=mk(SchedConfig, d.get("sched", {})),
             instrumentation=mk(InstrumentationConfig,
                                d.get("instrumentation", {})),
         )

@@ -515,6 +515,35 @@ class Ed25519BatchVerifier(BatchVerifier):
     def count(self) -> int:
         return len(self._precheck_fail)
 
+    def absorb(self, other: "Ed25519BatchVerifier") -> tuple[int, int]:
+        """Append every queued lane of `other` onto this verifier,
+        preserving order and precheck verdicts exactly; returns the
+        half-open lane range [start, end) the absorbed request occupies
+        in this verifier's bitmap. This is the merge seam the shared
+        verify scheduler (crypto/sched.py) uses to coalesce many
+        consumers' already-filled verifiers into one mega-batch dispatch
+        without re-running prechecks or copying per-item Python tuples
+        where a columnar add_batch chunk can ride through lazily.
+
+        `other` is left logically intact (its buffers are not drained),
+        but it must not be mutated or verified concurrently with the
+        absorb."""
+        start = self.count()
+        if other._items:
+            # logical order within a verifier is _items then _lazy;
+            # interleaving other's eager items after our pending lazy
+            # chunks would reorder OUR lanes, so expand ours first
+            self._materialize()
+            self._items.extend(other._items)
+        self._lazy.extend(other._lazy)
+        self._precheck_fail.extend(other._precheck_fail)
+        self._pub_buf += other._pub_buf
+        self._sig_buf += other._sig_buf
+        self._msg_buf += other._msg_buf
+        self._msg_lens.extend(other._msg_lens)
+        self._delta = None
+        return start, self.count()
+
     def verify(self) -> tuple[bool, list[bool]]:
         if not self.count():
             return False, []

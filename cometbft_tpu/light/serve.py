@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict, deque
 
+from ..crypto.sched import verify_context
 from ..types.validation import verify_commit_light
 from ..utils import trace
 from ..utils.metrics import light_metrics
@@ -176,11 +177,15 @@ class LightServe:
         subscriber_queue: int = 4096,
         mmr_store=None,
         trust_level: tuple[int, int] = (1, 3),
+        sched=None,
+        tenant: str = "",
     ):
         self.chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
         self.backend = backend
+        self.sched = sched  # shared VerifyScheduler (crypto/sched.py)
+        self.tenant = tenant
         self.trust_level = trust_level
         self.subscriber_queue = subscriber_queue
         self.cache = VerifiedCommitCache(cache_size)
@@ -310,10 +315,11 @@ class LightServe:
         vals = self.state_store.load_validators(height)
         if block is None or commit is None or vals is None:
             raise KeyError(f"height {height} not available to light serve")
-        verify_commit_light(
-            self.chain_id, vals, commit.block_id, height, commit,
-            backend=self.backend,
-        )
+        with verify_context(self.sched, self.tenant, "light"):
+            verify_commit_light(
+                self.chain_id, vals, commit.block_id, height, commit,
+                backend=self.backend,
+            )
         light_metrics().headers_verified_total.inc()
         return LightBlock(SignedHeader(block.header, commit), vals)
 

@@ -113,12 +113,16 @@ class AdmissionPipeline:
         verify_sigs: bool = True,
         backend: str = "tpu",
         queue_limit: int = 0,
+        sched=None,
+        tenant: str = "",
     ):
         self.mempool = mempool
         self.window = max(1, int(window))
         self.max_delay_s = max(0.0, float(max_delay_s))
         self.verify_sigs = verify_sigs
         self.backend = backend
+        self.sched = sched  # shared VerifyScheduler (crypto/sched.py)
+        self.tenant = tenant
         # 0 = derive from window: enough backlog to keep the drainer fed
         # without letting a stalled app grow the queue unboundedly
         self.queue_limit = queue_limit or self.window * 64
@@ -344,7 +348,11 @@ class AdmissionPipeline:
             signed.append((i, ok))
         if vf is None or not signed:
             return live, 0
-        _all_ok, bits = vf.verify()
+        if self.sched is not None:
+            _all_ok, bits = self.sched.submit(
+                vf, tenant=self.tenant, source="admission").result()
+        else:
+            _all_ok, bits = vf.verify()
         bad: set[int] = set()
         for (i, pre_ok), bit in zip(signed, bits):
             if not (pre_ok and bit):

@@ -176,6 +176,25 @@ class Node:
         else:
             sm_state = self.handshaker.handshake(self.app_conns)
 
+        # --- shared verification scheduler -----------------------------
+        # One process-wide scheduler per crypto backend: every verify
+        # consumer on this node (and any co-hosted chain) shares one
+        # coalescing dispatch path with per-tenant DRR fairness. The
+        # tenant key is the chain_id.
+        self.verify_sched = None
+        self.sched_tenant = self.genesis_doc.chain_id
+        if config.sched.enabled:
+            from ..crypto.sched import acquire_shared
+
+            self.verify_sched = acquire_shared(
+                config.base.crypto_backend,
+                max_coalesce_sigs=config.sched.max_coalesce_sigs,
+                max_coalesce_delay_ms=config.sched.max_coalesce_delay_ms,
+                stop_timeout_s=config.sched.stop_timeout_s,
+            )
+            self.verify_sched.set_tenant_weight(
+                self.sched_tenant, config.sched.tenant_weight)
+
         # --- mempool / evidence / executor ----------------------------
         self.mempool = CListMempool(
             self.app_conns,
@@ -198,6 +217,8 @@ class Node:
                 max_delay_s=config.mempool.admission_max_delay_ms / 1e3,
                 verify_sigs=config.mempool.admission_verify_sigs,
                 backend=config.base.crypto_backend,
+                sched=self.verify_sched,
+                tenant=self.sched_tenant,
             ))
         self.evidence_pool = EvidencePool(
             state_store=self.state_store, block_store=self.block_store,
@@ -218,6 +239,8 @@ class Node:
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
         )
+        self.executor.verify_sched = self.verify_sched
+        self.executor.sched_tenant = self.sched_tenant
         from ..state.pruner import Pruner
 
         self.pruner = Pruner(self.block_store, self.state_store)
@@ -254,6 +277,8 @@ class Node:
                 cache_size=config.light.cache_size,
                 subscriber_queue=config.light.subscriber_queue,
                 mmr_store=mmr_store,
+                sched=self.verify_sched,
+                tenant=self.sched_tenant,
             )
             # executor event handler: fires on consensus commits AND
             # blocksync replay, so the accumulator never misses a height
@@ -337,6 +362,8 @@ class Node:
             state=sm_state,
             backend=config.base.crypto_backend,
         )
+        self.blocksync_reactor.sched = self.verify_sched
+        self.blocksync_reactor.tenant = self.sched_tenant
         self.switch.add_reactor(self.blocksync_reactor)
         self.switch.add_reactor(self.statesync_reactor)
         self.pex_reactor = None
@@ -598,6 +625,13 @@ class Node:
             self.light_serve.stop()  # closes subscriber queues
         if self.da_serve is not None:
             self.da_serve.stop()  # drops retained shard sets
+        if self.verify_sched is not None:
+            # after every verify consumer above has stopped: last
+            # co-hosted chain out closes the shared scheduler
+            from ..crypto.sched import release_shared
+
+            release_shared(self.verify_sched)
+            self.verify_sched = None
         if self.pex_reactor is not None:
             self.pex_reactor.stop()  # also persists the address book
         self.consensus_reactor.stop()

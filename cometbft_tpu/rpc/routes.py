@@ -129,8 +129,11 @@ def dump_trace(env, params):
     `n` (cosmos-style paging name). Optional filters: `name` keeps
     records whose span name contains the substring (e.g. ``name=p2p.``
     for the wire hooks), `kind` requires an exact kind ("span" or
-    "event"). With filters, the last `n` MATCHING records out of the
-    newest 1000 are returned.
+    "event"), `tenant` keeps records touching that tenant (a record's
+    ``tenant`` field, or membership in its comma-separated ``tenants``
+    list — the shared-scheduler coalesce spans carry the latter). With
+    filters, the last `n` MATCHING records out of the newest 1000 are
+    returned.
     """
     from ..utils import trace
 
@@ -138,13 +141,26 @@ def dump_trace(env, params):
     n = max(1, min(n, 1000))
     name = str(params.get("name", "") or "")
     kind = str(params.get("kind", "") or "")
+    tenant = str(params.get("tenant", "") or "")
+
+    def _tenant_match(r):
+        if not tenant:
+            return True
+        if str(r.get("tenant", "")) == tenant:
+            return True
+        ts = r.get("tenants", "")
+        if isinstance(ts, str):
+            return tenant in ts.split(",")
+        return isinstance(ts, (list, tuple)) and tenant in ts
+
     if not trace.enabled:
         records = []
-    elif name or kind:
+    elif name or kind or tenant:
         records = [
             r for r in trace.tail(1000)
             if (not name or name in str(r.get("name", "")))
             and (not kind or r.get("kind") == kind)
+            and _tenant_match(r)
         ][-n:]
     else:
         records = trace.tail(n)

@@ -242,6 +242,11 @@ class BlockExecutor:
         # optional da.DAServe: when set, proposals carry a DA commitment
         # in the header and apply_block re-derives and enforces it
         self.da_encoder = None
+        # optional crypto.sched.VerifyScheduler: when set, LastCommit
+        # verification inside validate_block routes through the shared
+        # scheduler at consensus priority under this tenant (chain_id)
+        self.verify_sched = None
+        self.sched_tenant = ""
 
     # --- proposal side ---
     def create_proposal_block(
@@ -347,12 +352,16 @@ class BlockExecutor:
         life = _txlife.sampled_keys(block.data.txs) if _txlife.enabled else ()
         h_ = block.header.height
         t0 = _time.perf_counter()
-        validate_block(
-            state,
-            block,
-            backend=self.backend,
-            last_commit_preverified=last_commit_preverified,
-        )
+        from ..crypto.sched import verify_context
+
+        with verify_context(self.verify_sched, self.sched_tenant,
+                            "consensus"):
+            validate_block(
+                state,
+                block,
+                backend=self.backend,
+                last_commit_preverified=last_commit_preverified,
+            )
         state_metrics().block_verify_time.observe(_time.perf_counter() - t0)
         self.check_da_commitment(block)
         if self.evidence_pool is not None and block.evidence:

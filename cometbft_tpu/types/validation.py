@@ -28,6 +28,7 @@ BATCH_VERIFY_THRESHOLD = 2
 
 _SECP_TAG = "tendermint/PubKeySecp256k1"
 _BLS_TAG = "tendermint/PubKeyBls12_381"
+_ED_TAG = "tendermint/PubKeyEd25519"
 
 
 def _curve_of(tag: str) -> str:
@@ -117,13 +118,22 @@ def _verify_items(items, backend: str):
         # insertion order before any single verdict raises, which is
         # what the serial code did — the singles' verdicts are computed
         # early but deferred.
+        from ..crypto.sched import current_context
+
+        sched_ctx = current_context()
         in_flight = []
         for tag, (bv, idxs) in groups.items():
             if bv is None or not idxs:
                 continue
             t0 = _time.perf_counter()
             pending = None
-            if backend != "cpu" and hasattr(bv, "submit"):
+            if sched_ctx is not None and tag == _ED_TAG:
+                # shared-scheduler seam (crypto/sched.py): the filled
+                # verifier coalesces with other tenants'/sources' work
+                # into one mega-dispatch; the handle is
+                # pending-compatible and the bitmap slice is bit-exact
+                pending = sched_ctx.submit(bv)
+            elif backend != "cpu" and hasattr(bv, "submit"):
                 pending = bv.submit()
                 pending.prefetch()
             in_flight.append((tag, bv, idxs, t0, pending))

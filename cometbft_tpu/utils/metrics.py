@@ -513,6 +513,19 @@ class CryptoMetrics:
             "Batches placed per mesh device: sharded mega-batch shards "
             "and streamed whole-commit placements (skew attribution)",
             labels=("device", "mode"))
+        self.sched_queue_depth = reg.gauge(
+            "crypto", "sched_queue_depth",
+            "Verify requests queued in the shared scheduler, per tenant",
+            labels=("tenant",))
+        self.sched_coalesced_total = reg.counter(
+            "crypto", "sched_coalesced_total",
+            "Verify requests that shared a coalesced mega-batch dispatch, "
+            "per request source (consensus/blocksync/light/admission)",
+            labels=("source",))
+        self.sched_batch_sigs = reg.histogram(
+            "crypto", "sched_batch_sigs",
+            "Signatures per coalesced scheduler dispatch",
+            buckets=CryptoMetrics.BATCH_BUCKETS)
 
 
 _BUNDLES: dict[str, object] = {}

@@ -521,7 +521,29 @@ class MergedTrace:
             },
             "records": len(self.records),
             "heights": {"min": hs[0], "max": hs[-1]} if hs else None,
+            "tenants": self.tenant_rollup() or None,
         }
+
+    def tenant_rollup(self) -> dict:
+        """Per-tenant share of the shared verify scheduler's coalesced
+        dispatches (crypto.sched_coalesce spans): how many dispatches
+        each tenant rode in, its signature volume, and the dispatch
+        wall it shared. Empty when no scheduler spans were recorded."""
+        out: dict[str, dict] = {}
+        for r in self.records:
+            if r.get("name") != "crypto.sched_coalesce":
+                continue
+            per = r.get("per_tenant_sigs") or {}
+            dur = float(r.get("dur_ms", 0.0) or 0.0)
+            for tenant, sigs in per.items():
+                agg = out.setdefault(
+                    tenant, {"dispatches": 0, "sigs": 0, "ms": 0.0})
+                agg["dispatches"] += 1
+                agg["sigs"] += int(sigs)
+                agg["ms"] += dur
+        for agg in out.values():
+            agg["ms"] = round(agg["ms"], 3)
+        return out
 
 
 def merge(paths) -> MergedTrace:
@@ -562,6 +584,12 @@ def render_summary(mt: MergedTrace) -> str:
         lines.append("  %-12s id=%s.. offset=%+.3fms records=%d" % (
             name, str(info["node_id"])[:8], info["offset_s"] * 1e3,
             info["records"]))
+    if s.get("tenants"):
+        lines.append("verify scheduler tenants:")
+        for tenant, agg in sorted(s["tenants"].items()):
+            lines.append(
+                "  %-16s dispatches=%d sigs=%d shared_wall=%.1fms" % (
+                    tenant, agg["dispatches"], agg["sigs"], agg["ms"]))
     return "\n".join(lines)
 
 

@@ -1,0 +1,322 @@
+"""Shared verification scheduler (crypto/sched.py, ISSUE 15).
+
+Coalescing correctness is differential: the mega-batch's per-request
+verdict slices must be bit-exact with what each request's own
+``verify()`` would have returned — on accept AND on reject, across
+request boundaries. Fairness is the DRR bound: an adversarial hot
+tenant's share of any contended batch is limited by its weight. The
+lifecycle mirrors the admission pipeline: stop() fails queued and
+in-flight requests with tenant context, close() refuses later submits.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import sched as S
+from cometbft_tpu.crypto.ed25519 import Ed25519BatchVerifier, Ed25519PrivKey
+from cometbft_tpu.types import validation
+from cometbft_tpu.utils import factories as fx
+
+_PRIVS = [Ed25519PrivKey.generate() for _ in range(8)]
+
+
+def _bv(n, bad=(), tag=b""):
+    """A filled cpu-backend verifier with n sigs; indices in `bad` carry
+    a corrupted signature."""
+    bv = Ed25519BatchVerifier(backend="cpu")
+    for i in range(n):
+        p = _PRIVS[i % len(_PRIVS)]
+        msg = b"sched-msg-%d-" % i + tag
+        sig = p.sign(msg)
+        if i in bad:
+            sig = sig[:-1] + bytes([sig[-1] ^ 0xFF])
+        bv.add(p.pub_key(), msg, sig)
+    return bv
+
+
+# -- coalescing correctness ---------------------------------------------
+
+def test_coalesced_matches_sequential_accept_and_reject():
+    """Differential: every request's sliced verdict from one coalesced
+    dispatch equals its own standalone verify(), including rejects that
+    sit at and across request boundaries."""
+    shapes = [
+        (3, ()), (5, (0,)), (1, ()), (4, (3,)), (2, (0, 1)), (7, ()),
+    ]
+    expected = []
+    for i, (n, bad) in enumerate(shapes):
+        ok, bits = _bv(n, bad, tag=b"seq%d" % i).verify()
+        expected.append((ok, bits))
+
+    s = S.VerifyScheduler(backend="cpu", manual=True)
+    handles = [
+        s.submit(_bv(n, bad, tag=b"seq%d" % i), tenant="t%d" % (i % 2),
+                 source="consensus")
+        for i, (n, bad) in enumerate(shapes)
+    ]
+    assert s.drain_once() == len(shapes)
+    assert s.stats["dispatches"] == 1
+    for h, (ok, bits) in zip(handles, expected):
+        got_ok, got_bits = h.result(timeout=5)
+        assert (got_ok, got_bits) == (ok, bits)
+
+
+def test_coalesced_reject_bit_positions_exact():
+    """A bad lane in request k must never bleed into request k±1."""
+    s = S.VerifyScheduler(backend="cpu", manual=True)
+    h_good = s.submit(_bv(4, tag=b"g"), tenant="a", source="light")
+    h_bad = s.submit(_bv(4, bad=(0, 3), tag=b"b"), tenant="b",
+                     source="light")
+    h_good2 = s.submit(_bv(4, tag=b"g2"), tenant="a", source="blocksync")
+    s.drain_once()
+    ok, bits = h_good.result(5)
+    assert ok and bits == [True] * 4
+    ok, bits = h_bad.result(5)
+    assert not ok and bits == [False, True, True, False]
+    ok, bits = h_good2.result(5)
+    assert ok and bits == [True] * 4
+
+
+def test_empty_submit_matches_empty_verify():
+    s = S.VerifyScheduler(backend="cpu", manual=True)
+    ok, bits = s.submit(Ed25519BatchVerifier(backend="cpu")).result(1)
+    assert (ok, bits) == Ed25519BatchVerifier(backend="cpu").verify()
+
+
+def test_priority_classes_order_service():
+    """With the sig budget capping one batch, consensus work dispatches
+    ahead of earlier-queued admission work."""
+    s = S.VerifyScheduler(backend="cpu", manual=True,
+                          max_coalesce_sigs=4)
+    h_adm = s.submit(_bv(3, tag=b"adm"), tenant="a", source="admission")
+    h_cons = s.submit(_bv(3, tag=b"cons"), tenant="a", source="consensus")
+    s.drain_once()
+    assert h_cons._future.done()
+    assert not h_adm._future.done()
+    s.drain_once()
+    assert h_adm.result(5)[0]
+
+
+# -- fairness -----------------------------------------------------------
+
+def test_drr_hot_tenant_bounded_by_weight():
+    """Adversarial tenant floods 60 requests; victim submits 6. In every
+    contended batch the hot tenant's sig share stays near its DRR
+    entitlement (equal weights -> ~1/2) instead of the ~10/11 a FIFO
+    would give it, and the victim is fully served within the first
+    batches."""
+    s = S.VerifyScheduler(backend="cpu", manual=True,
+                          max_coalesce_sigs=64, quantum_sigs=8)
+    s.set_tenant_weight("hot", 1.0)
+    s.set_tenant_weight("victim", 1.0)
+    hot = [s.submit(_bv(4, tag=b"h%d" % i), tenant="hot", source="light")
+           for i in range(60)]
+    vic = [s.submit(_bv(4, tag=b"v%d" % i), tenant="victim",
+                    source="light") for i in range(6)]
+    batches = 0
+    while s.drain_once():
+        batches += 1
+        if batches == 1:
+            # victim fully served in the first contended batch: its 24
+            # sigs fit its ~32-sig half share of the 64-sig batch
+            assert all(h._future.done() for h in vic)
+            done_hot = sum(h._future.done() for h in hot)
+            # hot tenant bounded: it only backfills what the victim
+            # left unused — (64 - 24)/4 = 10 requests, not the 16 a
+            # FIFO would have given it before the victim's first
+            assert done_hot <= 10
+        assert batches < 64  # termination guard
+    assert all(h.result(5)[0] for h in hot + vic)
+    stats = s.tenant_stats()
+    assert stats["hot"] == 240 and stats["victim"] == 24
+
+
+def test_drr_weight_skews_share():
+    """A 3x-weight tenant drains ~3x the sigs of a 1x tenant from the
+    first contended batch."""
+    s = S.VerifyScheduler(backend="cpu", manual=True,
+                          max_coalesce_sigs=32, quantum_sigs=8)
+    s.set_tenant_weight("big", 3.0)
+    s.set_tenant_weight("small", 1.0)
+    big = [s.submit(_bv(4, tag=b"B%d" % i), tenant="big", source="light")
+           for i in range(20)]
+    small = [s.submit(_bv(4, tag=b"s%d" % i), tenant="small",
+                      source="light") for i in range(20)]
+    s.drain_once()
+    done_big = sum(h._future.done() for h in big)
+    done_small = sum(h._future.done() for h in small)
+    assert done_big > done_small
+    while s.drain_once():
+        pass
+    assert all(h.result(5)[0] for h in big + small)
+
+
+# -- latency floor ------------------------------------------------------
+
+def test_single_waiter_passthrough_no_delay_wait():
+    """A lone request on an otherwise-empty queue dispatches without
+    waiting out the coalescing window, via the pass-through path (no
+    absorb copy)."""
+    s = S.VerifyScheduler(backend="cpu", max_coalesce_delay_ms=500.0)
+    t0 = time.perf_counter()
+    ok, bits = s.submit(_bv(3), tenant="solo", source="consensus").result(5)
+    elapsed = time.perf_counter() - t0
+    assert ok and len(bits) == 3
+    assert elapsed < 0.25, f"single waiter waited {elapsed:.3f}s"
+    assert s.stats["passthrough"] == 1
+    s.close()
+
+
+def test_deadline_bounds_coalescing_wait():
+    """Two requests below the sig cap: the drainer lingers only until
+    the oldest request's deadline, then dispatches both together."""
+    s = S.VerifyScheduler(backend="cpu", max_coalesce_delay_ms=50.0,
+                          max_coalesce_sigs=1 << 20)
+    h1 = s.submit(_bv(2, tag=b"d1"), tenant="a", source="light")
+    h2 = s.submit(_bv(2, tag=b"d2"), tenant="b", source="light")
+    t0 = time.perf_counter()
+    assert h1.result(5)[0] and h2.result(5)[0]
+    assert time.perf_counter() - t0 < 2.0
+    assert s.stats["dispatches"] >= 1
+    s.close()
+
+
+# -- concurrency --------------------------------------------------------
+
+def test_concurrent_submit_stress_no_lost_or_duplicate_futures():
+    """16 producer threads x 12 submits each race the drainer; every
+    future resolves exactly once with its own request's verdict."""
+    s = S.VerifyScheduler(backend="cpu", max_coalesce_delay_ms=1.0,
+                          max_coalesce_sigs=256)
+    results = {}
+    lock = threading.Lock()
+    errors = []
+
+    def producer(tid):
+        try:
+            for i in range(12):
+                bad = (0,) if (tid + i) % 3 == 0 else ()
+                tag = b"c%d-%d" % (tid, i)
+                h = s.submit(_bv(2, bad=bad, tag=tag),
+                             tenant="t%d" % (tid % 4), source="light")
+                ok, bits = h.result(timeout=30)
+                expect_ok = not bad
+                with lock:
+                    results[(tid, i)] = (ok, bits, expect_ok)
+        except Exception as e:  # noqa: BLE001 — collect, assert below
+            with lock:
+                errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == 16 * 12
+    for (tid, i), (ok, bits, expect_ok) in results.items():
+        assert ok == expect_ok, (tid, i, ok, bits)
+        assert len(bits) == 2
+    st = s.stats
+    assert st["requests"] == 16 * 12
+    assert st["dispatches"] <= st["requests"]
+    s.close()
+
+
+# -- lifecycle ----------------------------------------------------------
+
+def test_submit_after_close_errors_immediately():
+    s = S.VerifyScheduler(backend="cpu")
+    s.close()
+    h = s.submit(_bv(2), tenant="late", source="light")
+    with pytest.raises(RuntimeError, match="closed"):
+        h.result(timeout=1)
+
+
+def test_stop_fails_queued_with_tenant_context():
+    """Requests still queued when stop() gives up carry the tenant and
+    source in the failure, mirroring the admission pipeline's abandoned
+    futures."""
+    s = S.VerifyScheduler(backend="cpu", manual=True, stop_timeout_s=0.1)
+    h = s.submit(_bv(3, tag=b"orphan"), tenant="chain-z", source="blocksync")
+    s.stop()  # manual mode: nothing drains it
+    with pytest.raises(RuntimeError) as ei:
+        h.result(timeout=1)
+    msg = str(ei.value)
+    assert "chain-z" in msg and "blocksync" in msg and "3-sig" in msg
+
+
+def test_stop_then_resubmit_restarts_drainer():
+    s = S.VerifyScheduler(backend="cpu")
+    assert s.submit(_bv(2, tag=b"r1"), tenant="a").result(5)[0]
+    s.stop()
+    assert s.submit(_bv(2, tag=b"r2"), tenant="a").result(5)[0]
+    s.close()
+
+
+# -- shared registry + multi-chain --------------------------------------
+
+def test_acquire_shared_refcounts_per_backend():
+    a = S.acquire_shared("cpu", max_coalesce_delay_ms=1.0)
+    b = S.acquire_shared("cpu")
+    assert a is b
+    S.release_shared(b)
+    assert not a._closed  # one ref left
+    S.release_shared(a)
+    assert a._closed
+    c = S.acquire_shared("cpu", max_coalesce_delay_ms=1.0)
+    assert c is not a  # closed singleton recreated
+    S.release_shared(c)
+
+
+def test_two_chains_one_scheduler_via_verify_context():
+    """Two tenants (distinct chain_ids) route real verify_commit calls
+    through one shared scheduler; per-tenant accounting sees both."""
+    sched = S.VerifyScheduler(backend="cpu", max_coalesce_delay_ms=1.0)
+    try:
+        for chain, tenant in (("chain-a", "chain-a"), ("chain-b", "chain-b")):
+            signers = fx.make_signers(6, seed=7)
+            vals = fx.make_validator_set(signers)
+            by_addr = {x.address(): x for x in signers}
+            bid = fx.make_block_id(chain.encode())
+            commit = fx.make_commit(chain, 3, 0, bid, vals, by_addr)
+            with S.verify_context(sched, tenant, "consensus"):
+                validation.verify_commit(chain, vals, bid, 3, commit,
+                                         backend="cpu")
+        stats = sched.tenant_stats()
+        assert stats.get("chain-a", 0) > 0
+        assert stats.get("chain-b", 0) > 0
+        assert sched.stats["requests"] >= 2
+    finally:
+        sched.close()
+
+
+def test_verify_context_reject_still_blames_exact_index():
+    """Routed through the scheduler, a bad signature still raises
+    ErrInvalidSignature naming the exact commit index (the sliced
+    bitmap is index-aligned)."""
+    sched = S.VerifyScheduler(backend="cpu", max_coalesce_delay_ms=1.0)
+    try:
+        signers = fx.make_signers(6, seed=13)
+        vals = fx.make_validator_set(signers)
+        by_addr = {x.address(): x for x in signers}
+        bid = fx.make_block_id(b"blame")
+        commit = fx.make_commit("blame-chain", 4, 0, bid, vals, by_addr)
+        sig = bytearray(commit.signatures[3].signature)
+        sig[0] ^= 0xFF
+        commit.signatures[3].signature = bytes(sig)
+        with S.verify_context(sched, "blame-chain", "consensus"):
+            with pytest.raises(validation.ErrInvalidSignature) as ei:
+                validation.verify_commit("blame-chain", vals, bid, 4,
+                                         commit, backend="cpu")
+        assert "index 3" in str(ei.value)
+    finally:
+        sched.close()
+
+
+def test_verify_context_none_sched_is_noop():
+    with S.verify_context(None, "t", "light"):
+        assert S.current_context() is None

@@ -410,6 +410,116 @@ def compare_das(ref: str, threshold: float,
     }
 
 
+def _city_record(flat_src: str):
+    """The city_combined record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        rec = data.get("city_combined")
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+# polarity the suffix heuristics would misread or miss: the coalesce
+# factor and the normalized dispatch-call rates are the headline of the
+# shared-scheduler work, and "dispatch_calls_per_1k_sigs" LOOKS like a
+# "sigs_per" throughput key but is a cost
+_CITY_DIRECTIONS = {
+    "coalescing.coalesce_factor": "higher",
+    "coalescing.dispatch_calls_per_1k_sigs_sequential": "lower",
+    "coalescing.dispatch_calls_per_1k_sigs_coalesced": "lower",
+    "das.withholding_detect_frac": "higher",
+}
+# non-measurement leaves: run geometry, raw counters that scale with
+# wall time, and 1-core wall-clock samples too noisy to diff
+_CITY_SKIP = ("gate.", "duration_s", "combined_wall_s", "clients",
+              "max_verify_calls", "joiner.blocks", "joiner.validators",
+              "joiner.seconds", "joiner.sigs_verified", "joiner.sched_",
+              "coalescing.tenants", "coalescing.requests",
+              "coalescing.sigs", "coalescing.sequential_dispatches",
+              "coalescing.coalesced_dispatches", "wall_ms",
+              "passthrough_")
+
+
+def compare_city(ref: str, threshold: float,
+                 relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the city-scale combined workload (ISSUE 15): the four
+    concurrent legs' SLO numbers plus the shared-scheduler coalescing
+    measurement. The coalesce factor is first-class — it dropping is
+    the regression the one-scheduler-N-tenants work exists to prevent;
+    the dispatch-call rates carry explicit polarity because the suffix
+    heuristics would read them as throughput."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _city_record(f.read())
+    base = _city_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no city_combined record on one side"}
+
+    b_flat, c_flat = _flatten(base), _flatten(cur)
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p in key for p in _CITY_SKIP):
+            continue
+        d = _CITY_DIRECTIONS.get(key) or direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    b_x = (base.get("coalescing") or {}).get("coalesce_factor")
+    c_x = (cur.get("coalescing") or {}).get("coalesce_factor")
+    factor = {"baseline": b_x, "current": c_x,
+              "worse": (b_x is not None and c_x is not None
+                        and c_x < b_x * (1 - threshold)),
+              "better": (b_x is not None and c_x is not None
+                         and c_x > b_x * (1 + threshold))}
+    regs = [r for r in rows if r["worse"]]
+    if factor["worse"]:
+        regs.append({"key": "coalesce_factor", **factor})
+    return {
+        "file": relpath, "mode": "city_combined",
+        "coalesce_factor": factor,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_city(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"city combined: skipped ({rep['skipped']})")
+        return
+    x = rep["coalesce_factor"]
+    tag = ("REGRESSION" if x["worse"]
+           else "improved  " if x["better"] else "          ")
+    print(f"city combined ({rep['file']}): {tag} coalesce factor "
+          f"{x['baseline']} -> {x['current']}")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-44s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_das(rep: dict) -> None:
     if "skipped" in rep:
         print(f"das sampling: skipped ({rep['skipped']})")
@@ -484,6 +594,9 @@ def main(argv=None) -> int:
                     help="also diff the data-availability sampling "
                          "workload (withholding detection fraction "
                          "first-class)")
+    ap.add_argument("--city", action="store_true",
+                    help="also diff the city-scale combined workload "
+                         "(shared-scheduler coalesce factor first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -503,8 +616,10 @@ def main(argv=None) -> int:
                if args.bls else None)
     das_rep = (compare_das(args.ref, args.threshold)
                if args.das else None)
+    city_rep = (compare_city(args.ref, args.threshold)
+                if args.city else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    for extra in (ingest_rep, bls_rep, das_rep):
+    for extra in (ingest_rep, bls_rep, das_rep, city_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -516,6 +631,8 @@ def main(argv=None) -> int:
         summary["bls_crossover"] = bls_rep
     if das_rep is not None:
         summary["das_sampling"] = das_rep
+    if city_rep is not None:
+        summary["city_combined"] = city_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -541,6 +658,8 @@ def main(argv=None) -> int:
             _print_bls(bls_rep)
         if das_rep is not None:
             _print_das(das_rep)
+        if city_rep is not None:
+            _print_city(city_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "
