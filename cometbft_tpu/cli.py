@@ -446,6 +446,71 @@ def cmd_bootstrap_state(args) -> int:
     return 0
 
 
+def cmd_replica(args) -> int:
+    """Stateless serving replica (replication/replica.py, ROADMAP #3):
+    bootstrap from a core node's replication snapshot, tail its feed,
+    and serve the light/DA surfaces byte-identically with zero
+    consensus state. Prints one JSON line with the bound addresses so
+    drivers (tools/workloads.py --city --replicas) can discover the
+    ephemeral ports."""
+    from .replication import Replica
+
+    cfg = None
+    cfg_file = _cfg_paths(args.home)["config_file"]
+    if os.path.exists(cfg_file):
+        from .config import Config
+
+        cfg = Config.load(cfg_file)
+    rep_cfg = cfg.replication if cfg is not None else None
+    core_url = args.core_url or (rep_cfg.core_url if rep_cfg else "")
+    if not core_url:
+        print("replica: --core-url (or [replication] core_url) required",
+              file=sys.stderr)
+        return 1
+    host, _, port = args.laddr.removeprefix("tcp://").rpartition(":")
+    mhost, _, mport = args.metrics_laddr.rpartition(":")
+    rep = Replica(
+        core_url,
+        name=(args.name
+              or (rep_cfg.tenant if rep_cfg else "")
+              or f"replica-{os.getpid()}"),
+        backend=args.backend,
+        rpc_host=host or "127.0.0.1",
+        rpc_port=int(port or 0),
+        metrics_host=mhost or "127.0.0.1",
+        metrics_port=int(mport or 0),
+        retain_frames=(rep_cfg.retain_frames if rep_cfg else 1024),
+        max_lag_heights=(args.max_lag_heights
+                         if args.max_lag_heights is not None
+                         else (rep_cfg.max_lag_heights if rep_cfg else 16)),
+        forward_admission=(not args.no_forward) and (
+            rep_cfg.forward_admission if rep_cfg else True),
+    )
+    try:
+        rep.start()
+    except Exception as e:  # noqa: BLE001 — operator-facing boot error
+        print(f"replica failed to start: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "name": rep.name,
+        "rpc": list(rep.rpc_addr),
+        "metrics": list(rep.metrics_addr) if rep.metrics_addr else None,
+        "core": core_url,
+    }), flush=True)
+    import signal as _signal
+
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _term)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        rep.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -510,6 +575,22 @@ def main(argv=None) -> int:
     sp.add_argument("--trust-height", type=int, default=0)
     sp.add_argument("--trust-hash", default="")
     sp.set_defaults(fn=cmd_bootstrap_state)
+    sp = sub.add_parser("replica")
+    sp.add_argument("--core-url", default="",
+                    help="http://host:port of the core node's RPC "
+                         "(default: [replication] core_url)")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:0",
+                    help="replica RPC listen address (port 0 = ephemeral)")
+    sp.add_argument("--metrics-laddr", default="127.0.0.1:0",
+                    help="metrics/healthz listen address")
+    sp.add_argument("--name", default="",
+                    help="replica tenant name on the shared scheduler")
+    sp.add_argument("--backend", default="cpu", choices=("cpu", "tpu"))
+    sp.add_argument("--max-lag-heights", type=int, default=None,
+                    help="healthz turns 503 past this feed lag")
+    sp.add_argument("--no-forward", action="store_true",
+                    help="disable broadcast_tx_* admission forwarding")
+    sp.set_defaults(fn=cmd_replica)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = ap.parse_args(argv)

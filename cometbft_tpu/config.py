@@ -303,6 +303,49 @@ class DAConfig:
 
 
 @dataclass
+class ReplicationConfig:
+    """Scale-out serving plane (replication/, ROADMAP #3).
+
+    When `serve` is on (core role), the node publishes every committed
+    height as one frame — header, validator set, canonical + seen
+    commits, verified-commit certificate, 1x DA payload — on the
+    resumable `/replication_feed` stream, retains the last
+    `retain_frames` frames for cursor replay, and serves a bootstrap
+    snapshot (MMR leaf sequence + retained frames) over
+    replication_snapshot / replication_snapshot_chunk. Stateless
+    replicas (`cli.py replica`, replication/replica.py) consume the
+    feed and serve /light_stream, MMR proofs, bisection, DA samples and
+    admission forwarding byte-identically with zero consensus state.
+    The replica-role fields (core_url and below) are ignored by a core
+    node; `cli.py replica` reads them."""
+
+    serve: bool = False
+    # frames kept resident for cursor replay; a replica whose cursor
+    # falls behind this window re-bootstraps from the snapshot
+    retain_frames: int = 1024
+    # snapshot blob chunking for the statesync-shaped fetch protocol
+    snapshot_chunk_bytes: int = 262144
+    # ---- replica role (cli.py replica) ----
+    core_url: str = ""  # http://host:port of the core feed
+    # verify + forward broadcast_tx_* to the core through the replica's
+    # own admission window (replica registers as its own DRR tenant)
+    forward_admission: bool = True
+    # healthz readiness: 503 while the feed-lag gauge exceeds this
+    max_lag_heights: int = 16
+    # replica tenant name on the shared VerifyScheduler ("" derives one)
+    tenant: str = ""
+
+    def validate(self) -> None:
+        if self.retain_frames < 1:
+            raise ValueError("replication.retain_frames must be >= 1")
+        if self.snapshot_chunk_bytes < 1:
+            raise ValueError(
+                "replication.snapshot_chunk_bytes must be >= 1")
+        if self.max_lag_heights < 0:
+            raise ValueError("replication.max_lag_heights must be >= 0")
+
+
+@dataclass
 class SchedConfig:
     """Shared verification scheduler (crypto/sched.py, ROADMAP #4).
 
@@ -383,6 +426,8 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     light: LightConfig = field(default_factory=LightConfig)
     da: DAConfig = field(default_factory=DAConfig)
+    replication: ReplicationConfig = field(
+        default_factory=ReplicationConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -391,7 +436,7 @@ class Config:
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
-                        self.light, self.da, self.sched,
+                        self.light, self.da, self.replication, self.sched,
                         self.instrumentation):
             section.validate()
 
@@ -434,6 +479,7 @@ class Config:
             emit("storage", self.storage),
             emit("light", self.light),
             emit("da", self.da),
+            emit("replication", self.replication),
             emit("sched", self.sched),
             emit("instrumentation", self.instrumentation),
         ]
@@ -474,6 +520,7 @@ class Config:
             storage=mk(StorageConfig, d.get("storage", {})),
             light=mk(LightConfig, d.get("light", {})),
             da=mk(DAConfig, d.get("da", {})),
+            replication=mk(ReplicationConfig, d.get("replication", {})),
             sched=mk(SchedConfig, d.get("sched", {})),
             instrumentation=mk(InstrumentationConfig,
                                d.get("instrumentation", {})),

@@ -68,21 +68,30 @@ class DAServe:
     def on_commit(self, block, resp=None) -> None:
         """Commit-time hook (same contract as LightServe.on_commit):
         extend + commit + retain the applied block's payload."""
-        header = block.header
-        payload = block_payload(block.data)
+        self.apply_payload(block.header.height, block_payload(block.data))
+
+    def apply_payload(self, height: int, payload: bytes) -> _HeightEntry:
+        """Extend + commit + retain one height's raw payload. The RS
+        extension and the shard commitment are deterministic, so a
+        serving replica applying the payload off the replication feed
+        rebuilds the commitment, shards and opening proofs byte-exactly
+        (the feed carries the 1x systematic payload, not the 2x shard
+        set). Returns the retained entry so callers can cross-check
+        `entry.da_root` against an advertised root."""
         with trace.span(
-            "da.encode", height=header.height, bytes=len(payload)
+            "da.encode", height=height, bytes=len(payload)
         ) as sp:
             shards = extend_payload(payload, self.k, self.m)
             com, proofs = commit_shards(shards, self.k, len(payload))
             sp.add(shards=com.n, shard_bytes=len(shards[0]))
         entry = _HeightEntry(com, shards, proofs)
         with self._lock:
-            self._heights[header.height] = entry
+            self._heights[height] = entry
             self._encoded += 1
             while len(self._heights) > self.cfg.retain_heights:
                 h, _ = self._heights.popitem(last=False)
                 self._withhold.pop(h, None)
+        return entry
 
     # --------------------------------------------------------- serving side
     def set_withholding(self, height: int, indices) -> None:

@@ -110,6 +110,12 @@ class VerifiedCommitCache:
             entry.event.set()
             return result
 
+    def peek(self, height: int):
+        """Cached verdict for a height, or None — never triggers a
+        verify (the replication feed reports cert status with it)."""
+        with self._lock:
+            return self._done.get(height)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._done)
@@ -179,6 +185,7 @@ class LightServe:
         trust_level: tuple[int, int] = (1, 3),
         sched=None,
         tenant: str = "",
+        payload_retain: int = 4096,
     ):
         self.chain_id = chain_id
         self.block_store = block_store
@@ -202,6 +209,11 @@ class LightServe:
         self._next_sub_id = 0
         self._lock = threading.Lock()
         self.heights_served = 0
+        # rendered-payload ring: lets a reconnecting subscriber resume
+        # from a cursor (`subscribe(since=H)`) without re-rendering —
+        # the replayed dicts are the exact objects live pushes carried
+        self.payload_retain = max(1, int(payload_retain))
+        self._payloads: OrderedDict[int, dict] = OrderedDict()
         # optional da.DAServe (node wiring): stream payloads then carry
         # the height's DA commitment fields for sampling clients
         self.da_serve = None
@@ -229,6 +241,9 @@ class LightServe:
                 leaf = self.mmr.append(header.hash())
                 sp.add(leaf=leaf, size=self.mmr.leaf_count)
             payload = self._render_payload(header)
+            self._payloads[header.height] = payload
+            while len(self._payloads) > self.payload_retain:
+                self._payloads.popitem(last=False)
             subs = list(self._subs.values())
             self.heights_served += 1
         for sub in subs:
@@ -394,12 +409,39 @@ class LightServe:
         plan = self.plan_bisection(trusted_height, target_height)
         return [self.verified_commit(h) for h in plan]
 
+    # -- replica bootstrap -----------------------------------------------
+    def bootstrap(self, base_height: int, leaf_hashes: list[bytes]) -> None:
+        """Seed an EMPTY accumulator from a snapshot's leaf sequence.
+
+        The MMR is append-only post-order, so replaying the same leaves
+        reproduces the core's accumulator bit-exactly; subsequent
+        `on_commit` calls continue from `base_height + len(leaves)`.
+        Used by the serving-replica snapshot restore (replication/)."""
+        with self._lock:
+            if self.mmr.leaf_count or self.base_height is not None:
+                raise RuntimeError(
+                    "light serve bootstrap requires an empty accumulator")
+            self.base_height = base_height
+            if self._mmr_store is not None:
+                self._mmr_store.save_base_height(base_height)
+            for leaf in leaf_hashes:
+                self.mmr.append(leaf)
+
     # -- subscriptions ---------------------------------------------------
-    def subscribe(self) -> tuple[int, StreamSubscriber]:
+    def subscribe(self, since: int | None = None
+                  ) -> tuple[int, StreamSubscriber]:
+        """Register a stream subscriber; ``since=H`` preloads every
+        retained payload with height > H (cursor resume for failover —
+        a client that lost its connection at H sees no gap as long as
+        the ring still covers H+1)."""
         with self._lock:
             sub_id = self._next_sub_id
             self._next_sub_id += 1
             sub = self._subs[sub_id] = StreamSubscriber(self.subscriber_queue)
+            if since is not None:
+                for h, payload in self._payloads.items():
+                    if h > since:
+                        sub.push(payload)
             light_metrics().serve_subscribers.set(len(self._subs))
         return sub_id, sub
 

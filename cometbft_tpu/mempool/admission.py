@@ -321,6 +321,7 @@ class AdmissionPipeline:
         if _trace.enabled:
             _trace.emit(
                 "mempool.admit_window", "span",
+                tenant=self.tenant,
                 n=len(batch), dup=n_dup, sig_fail=n_sig_fail,
                 app_fail=n_app_fail, admitted=len(admitted),
                 sig_ms=round((t2 - t1) * 1e3, 3),

@@ -59,7 +59,7 @@ class Node:
             _metrics.p2p_metrics, _metrics.state_metrics,
             _metrics.blocksync_metrics, _metrics.statesync_metrics,
             _metrics.light_metrics, _metrics.da_metrics,
-            _metrics.crypto_metrics,
+            _metrics.replication_metrics, _metrics.crypto_metrics,
         ):
             _mk()
         if config.instrumentation.trace_sink and not _trace.enabled:
@@ -286,6 +286,25 @@ class Node:
             # stream DA commitment fields in /light_stream payloads
             self.light_serve.da_serve = self.da_serve
 
+        # --- replication feed (scale-out serving plane) ----------------
+        self.replication_feed = None
+        if config.replication.serve:
+            from ..replication import ReplicationFeed
+
+            self.replication_feed = ReplicationFeed(
+                self.genesis_doc.chain_id,
+                self.block_store,
+                self.state_store,
+                light_serve=self.light_serve,
+                da_serve=self.da_serve,
+                retain_frames=config.replication.retain_frames,
+                snapshot_chunk_bytes=config.replication.snapshot_chunk_bytes,
+            )
+            # hook AFTER the DA and light handlers: a frame is built from
+            # the height's already-rendered serving state (DA commitment,
+            # verified-commit cache) so replicas see what the core serves
+            self.executor.event_handlers.append(self.replication_feed.on_commit)
+
         # --- consensus -------------------------------------------------
         self.wal = WAL(_p(config.consensus.wal_file))
         self.consensus = ConsensusState(
@@ -401,6 +420,7 @@ class Node:
             consensus_reactor=self.consensus_reactor,
             light_serve=self.light_serve,
             da_serve=self.da_serve,
+            replication_feed=self.replication_feed,
         )
         self.rpc_server = None
         self.grpc_server = None
@@ -621,6 +641,8 @@ class Node:
         self.consensus.stop()
         self.mempool.close()  # admission drainer + gossip notifier
         self.pruner.stop()
+        if self.replication_feed is not None:
+            self.replication_feed.stop()  # closes feed subscribers
         if self.light_serve is not None:
             self.light_serve.stop()  # closes subscriber queues
         if self.da_serve is not None:

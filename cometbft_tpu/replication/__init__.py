@@ -1,0 +1,13 @@
+"""Scale-out serving plane: replication feed + stateless replicas.
+
+The core node publishes an ordered, resumable frame stream
+(`ReplicationFeed`) off the commit hook; `Replica` processes consume it
+— snapshot bootstrap first, then a cursor-tailed live feed — and serve
+the light-client / DA surfaces byte-identically with zero consensus
+state. See ROADMAP item #3 and README §serving-replicas.
+"""
+
+from .feed import CursorTooOld, ReplicationFeed
+from .replica import Replica
+
+__all__ = ["CursorTooOld", "ReplicationFeed", "Replica"]

@@ -1,0 +1,626 @@
+"""Stateless serving replica (ROADMAP item #3: scale-out serving).
+
+A replica is a separate process (``cli.py replica``) holding ZERO
+consensus state: no block store, no state machine, no p2p switch. It
+bootstraps from the core's replication snapshot (statesync Snapshot
+shape over ``replication_snapshot``/``replication_snapshot_chunk``),
+then tails the core's ``/replication_feed`` stream, folding each frame
+into its own serving state:
+
+- a real ``LightServe`` over a frame-backed store facade — the MMR is
+  rebuilt from the same leaf sequence (append-only post-order, so the
+  accumulator is bit-exact) and commit verification runs lazily through
+  the replica's own ``VerifiedCommitCache`` under the same block-commit/
+  seen-commit resolution rules, so ``/light_stream`` lines, MMR
+  ancestry proofs and bisection pivots are byte-identical to the core's;
+- a real ``DAServe`` re-encoding each frame's 1x systematic payload
+  (RS extension + shard commitment are deterministic) and cross-checking
+  the advertised ``da_root``, so ``da_sample`` openings match byte-for-
+  byte;
+- an ``AdmissionPipeline`` over a forwarding mempool facade: txs hitting
+  the replica's ``broadcast_tx_*`` are batch-verified in the REPLICA's
+  admission window (the replica registers as its own tenant on the
+  shared ``VerifyScheduler``, so the PR-15 DRR fairness bounds a hot
+  replica), then admitted txs are forwarded to the core one
+  ``broadcast_tx_sync`` each.
+
+Readiness: ``/healthz`` on the replica's metrics listener reports 503
+while snapshot-bootstrapping or while the ``replication_feed_lag_heights``
+gauge exceeds ``max_lag_heights``, 200 once caught up and serving.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+
+from ..crypto.keys import tmhash
+from ..light.serve import LightServe
+from ..light.store import _decode_vals
+from ..mempool.admission import AdmissionPipeline
+from ..mempool.mempool import ErrTxInCache, ErrTxTooLarge
+from ..rpc.client import HTTPClient
+from ..rpc.routes import Env, REPLICA_ROUTES
+from ..rpc.server import RPCServer
+from ..statesync.snapshots import Snapshot, SnapshotPool, blob_hash
+from ..types import Commit, Header
+from ..utils import trace
+from ..utils.metrics import MetricsServer, replication_metrics
+
+
+class _ReplicaBlock:
+    """Header-only block shim: every serving path a replica exercises
+    (`LightServe.on_commit`, `_verify_height`) touches only `.header`."""
+
+    __slots__ = ("header",)
+
+    def __init__(self, header):
+        self.header = header
+
+
+class _FrameStore:
+    """Block-store + state-store facade over applied feed frames.
+
+    Mirrors the core's resolution semantics exactly: the canonical
+    commit FOR height h is frame h+1's embedded LastCommit, the seen
+    commit is frame h's own; validators at h ride frame h. Bounded to
+    the same retention window as the feed — heights that age out serve
+    None, exactly like a pruned core store."""
+
+    def __init__(self, retain: int = 1024):
+        self.retain = max(1, int(retain))
+        self._frames: OrderedDict[int, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def put(self, height, header, last_commit, seen_commit, vals) -> None:
+        with self._lock:
+            self._frames[height] = (header, last_commit, seen_commit, vals)
+            while len(self._frames) > self.retain:
+                self._frames.popitem(last=False)
+
+    def _get(self, height: int):
+        with self._lock:
+            return self._frames.get(height)
+
+    # -- block-store role -----------------------------------------------
+    def load_block(self, height: int):
+        f = self._get(height)
+        return _ReplicaBlock(f[0]) if f is not None else None
+
+    def load_block_commit(self, height: int):
+        nxt = self._get(height + 1)
+        if nxt is None or nxt[1] is None or not nxt[1].signatures:
+            return None
+        return nxt[1]
+
+    def load_seen_commit(self, height: int):
+        f = self._get(height)
+        return f[2] if f is not None else None
+
+    # -- state-store role -------------------------------------------------
+    def load_validators(self, height: int):
+        f = self._get(height)
+        return f[3] if f is not None else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._frames)
+
+
+class _CheckResult:
+    __slots__ = ("code", "gas_wanted")
+
+    def __init__(self):
+        self.code = 0
+        self.gas_wanted = 0
+
+
+class _ForwardTarget:
+    """AdmissionPipeline mempool facade that forwards admitted txs to
+    the core instead of inserting them locally.
+
+    precheck keeps the pipeline's direct-path semantics (oversize →
+    ErrTxTooLarge, replica-local LRU dedup → ErrTxInCache) so bad or
+    duplicate txs never cost a core round-trip; signature rejects are
+    the pipeline's own batch-verify stage. A core rejection surfaces to
+    the replica caller as the stage-3 insert error."""
+
+    def __init__(self, client, tenant: str, max_tx_bytes: int = 1024 * 1024,
+                 cache_size: int = 10000):
+        self._client = client
+        self.tenant = tenant
+        self.max_tx_bytes = max_tx_bytes
+        self.cache_size = max(1, int(cache_size))
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+        self.forwarded_ok = 0
+        self.forwarded_rejected = 0
+        self.forward_errors = 0
+
+    def precheck(self, tx: bytes) -> bytes:
+        if len(tx) > self.max_tx_bytes:
+            raise ErrTxTooLarge(
+                f"tx too large: {len(tx)} > {self.max_tx_bytes}")
+        key = tmhash(tx)
+        with self._lock:
+            if key in self._seen:
+                raise ErrTxInCache("tx already seen by replica")
+            self._seen[key] = None
+            while len(self._seen) > self.cache_size:
+                self._seen.popitem(last=False)
+        return key
+
+    def app_check_batch(self, txs):
+        # the core re-runs CheckTx on forward; the replica stage is a
+        # pass-through so forwarding cost stays one round-trip per tx
+        return [_CheckResult() for _ in txs]
+
+    def insert_batch(self, items):
+        m = replication_metrics()
+        errs = []
+        for key, tx, _gas in items:
+            try:
+                r = self._client.broadcast_tx_sync(tx=tx.hex())
+                code = int(r.get("code", 0))
+            except Exception as e:  # noqa: BLE001 — core unreachable
+                self.forward_errors += 1
+                m.forwarded_txs_total.inc(1, self.tenant, "error")
+                self.note_rejected(key)
+                errs.append(ValueError(f"forward to core failed: {e}"))
+                continue
+            if code == 0:
+                self.forwarded_ok += 1
+                m.forwarded_txs_total.inc(1, self.tenant, "ok")
+                errs.append(None)
+            else:
+                self.forwarded_rejected += 1
+                m.forwarded_txs_total.inc(1, self.tenant, "rejected")
+                self.note_rejected(key)
+                errs.append(ValueError(
+                    f"core rejected tx: {r.get('log', '')}"))
+        return errs
+
+    def note_rejected(self, key) -> None:
+        with self._lock:
+            self._seen.pop(key, None)
+
+    def notify_new_txs(self, txs) -> None:
+        pass
+
+
+class _ReplicaMempool:
+    """Env.mempool facade: the broadcast routes drive the replica's
+    admission pipeline (sync blocks on the verdict, async enqueues)."""
+
+    def __init__(self, pipeline: AdmissionPipeline):
+        self.pipeline = pipeline
+
+    def check_tx(self, tx: bytes, from_peer: str = "") -> None:
+        self.pipeline.check_tx(tx, from_peer)
+
+    def submit_tx(self, tx: bytes):
+        return self.pipeline.submit(tx)
+
+    def size(self) -> int:
+        return 0
+
+    def total_bytes(self) -> int:
+        return 0
+
+    def reap_max_txs(self, n: int):
+        return []
+
+
+class _DAShim:
+    """Minimal config.DAConfig stand-in for a feed-driven DAServe: the
+    geometry comes from the frames, not a local config file."""
+
+    def __init__(self, k: int, m: int, retain_heights: int):
+        self.enabled = True
+        self.data_shards = k
+        self.parity_shards = m
+        self.retain_heights = retain_heights
+
+
+class Replica:
+    """Feed consumer + stateless serving surfaces for one core node."""
+
+    def __init__(
+        self,
+        core_url: str,
+        *,
+        name: str = "",
+        backend: str = "cpu",
+        rpc_host: str = "127.0.0.1",
+        rpc_port: int = 0,
+        metrics_host: str = "127.0.0.1",
+        metrics_port: int | None = None,
+        retain_frames: int = 1024,
+        max_lag_heights: int = 16,
+        healthz_window_s: float = 30.0,
+        forward_admission: bool = True,
+        da_retain_heights: int = 64,
+        light_cache_size: int = 4096,
+        subscriber_queue: int = 4096,
+        payload_retain: int = 4096,
+        admission_window: int = 256,
+        admission_max_delay_s: float = 0.002,
+        feed_timeout_s: float = 30.0,
+        sched=None,
+        client=None,
+    ):
+        self.core_url = core_url.rstrip("/")
+        self.name = name or f"replica-{id(self) & 0xFFFF:04x}"
+        self.backend = backend
+        self.rpc_host, self.rpc_port = rpc_host, rpc_port
+        self.metrics_host, self.metrics_port = metrics_host, metrics_port
+        self.retain_frames = retain_frames
+        self.max_lag_heights = max_lag_heights
+        self.healthz_window_s = healthz_window_s
+        self.forward_admission = forward_admission
+        self.da_retain_heights = da_retain_heights
+        self.light_cache_size = light_cache_size
+        self.subscriber_queue = subscriber_queue
+        self.payload_retain = payload_retain
+        self.admission_window = admission_window
+        self.admission_max_delay_s = admission_max_delay_s
+        self.feed_timeout_s = feed_timeout_s
+        self.client = client or HTTPClient(self.core_url)
+        self._own_sched = sched is None
+        self.sched = sched
+
+        self.chain_id: str = ""
+        self.store: _FrameStore | None = None
+        self.light_serve: LightServe | None = None
+        self.da_serve = None
+        self.pipeline: AdmissionPipeline | None = None
+        self.env: Env | None = None
+        self.rpc_server: RPCServer | None = None
+        self.metrics_server: MetricsServer | None = None
+        self.snapshots = SnapshotPool()
+        self.snapshot_height = 0
+
+        self.bootstrapped = False
+        self.applied_height = 0
+        self.core_tip = 0
+        self.applied_frames = 0
+        self.gaps = 0
+        self.feed_connects = 0
+        self.cert_kinds: dict[str, int] = {}
+        self._apply_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._resp = None  # live feed response, closed on stop()
+
+    # -- readiness ---------------------------------------------------------
+    def _set_lag(self) -> None:
+        lag = max(0, self.core_tip - self.applied_height)
+        replication_metrics().feed_lag_heights.set(lag)
+
+    def ready(self) -> tuple[bool, dict]:
+        """healthz readiness probe: bootstrapped AND the feed-lag gauge
+        within bounds (503 otherwise — load balancers drain us)."""
+        lag = replication_metrics().feed_lag_heights.values().get((), 0.0)
+        ok = self.bootstrapped and lag <= self.max_lag_heights
+        return ok, {
+            "replica": self.name,
+            "bootstrapped": self.bootstrapped,
+            "feed_lag_heights": lag,
+            "max_lag_heights": self.max_lag_heights,
+        }
+
+    # -- serving state -----------------------------------------------------
+    def _build_serving(self) -> None:
+        self.store = _FrameStore(self.retain_frames)
+        self.light_serve = LightServe(
+            self.chain_id,
+            self.store,
+            self.store,
+            backend=self.backend,
+            cache_size=self.light_cache_size,
+            subscriber_queue=self.subscriber_queue,
+            sched=self.sched,
+            tenant=self.name,
+            payload_retain=self.payload_retain,
+        )
+        self.da_serve = None  # built lazily from the first DA frame
+        self.light_serve.da_serve = None
+
+    def _ensure_da(self, k: int, m: int) -> None:
+        if self.da_serve is None:
+            from ..da.serve import DAServe
+
+            self.da_serve = DAServe(_DAShim(k, m, self.da_retain_heights))
+            self.light_serve.da_serve = self.da_serve
+            if self.env is not None:
+                self.env.da_serve = self.da_serve
+
+    # -- frame application -------------------------------------------------
+    def _apply_frame(self, frame: dict, append_light: bool = True) -> bool:
+        h = int(frame["h"])
+        with self._apply_lock:
+            if append_light and h <= self.applied_height:
+                return False  # duplicate (reconnect overlap)
+            t0 = time.perf_counter()
+            with trace.span("replication.replica_apply", height=h) as sp:
+                header = Header.decode(bytes.fromhex(frame["hdr"]))
+                vals = (_decode_vals(bytes.fromhex(frame["vals"]))
+                        if frame.get("vals") else None)
+                last = (Commit.decode(bytes.fromhex(frame["last"]))
+                        if frame.get("last") else None)
+                seen = (Commit.decode(bytes.fromhex(frame["seen"]))
+                        if frame.get("seen") else None)
+                self.store.put(h, header, last, seen, vals)
+                kind = (frame.get("cert") or {}).get("kind", "none")
+                self.cert_kinds[kind] = self.cert_kinds.get(kind, 0) + 1
+                da = frame.get("da")
+                if da is not None:
+                    self._ensure_da(int(da["k"]), int(da["m"]))
+                    entry = self.da_serve.apply_payload(
+                        h, bytes.fromhex(da["payload"]))
+                    want = da.get("root")
+                    if want and entry.da_root.hex() != want:
+                        raise RuntimeError(
+                            f"DA root mismatch at {h}: rebuilt "
+                            f"{entry.da_root.hex()} != advertised {want}")
+                if append_light:
+                    if self.applied_height and h != self.applied_height + 1:
+                        self.gaps += 1
+                    self.light_serve.on_commit(_ReplicaBlock(header))
+                    self.applied_height = h
+                    self.applied_frames += 1
+                    if h > self.core_tip:
+                        self.core_tip = h
+                sp.add(da=da is not None, applied=append_light)
+            m = replication_metrics()
+            m.replica_applied_total.inc()
+            m.replica_apply_seconds.observe(time.perf_counter() - t0)
+            self._set_lag()
+        return True
+
+    # -- snapshot bootstrap ------------------------------------------------
+    def _bootstrap(self) -> None:
+        meta = self.client.replication_snapshot()
+        snap = Snapshot(
+            height=int(meta["height"]),
+            format=int(meta["format"]),
+            chunks=int(meta["chunks"]),
+            hash=bytes.fromhex(meta["hash"]),
+            metadata=base64.b64decode(meta["metadata"]),
+        )
+        self.snapshots.add(snap, peer=self.core_url)
+        best = self.snapshots.best()
+        if best is None:
+            raise RuntimeError("no acceptable replication snapshot")
+        parts = []
+        for i in range(best.chunks):
+            r = self.client.replication_snapshot_chunk(
+                chunk=str(i), height=str(best.height))
+            parts.append(base64.b64decode(r["data"]))
+        blob = b"".join(parts)
+        if blob_hash(blob) != best.hash:
+            self.snapshots.reject(best)
+            raise RuntimeError("replication snapshot hash mismatch")
+        doc = json.loads(blob)
+        if self.chain_id and doc["chain_id"] != self.chain_id:
+            raise RuntimeError(
+                f"snapshot chain {doc['chain_id']!r} != {self.chain_id!r}")
+        self.chain_id = doc["chain_id"]
+        self.light_serve.chain_id = self.chain_id
+        base = int(doc["base_height"])
+        frames = [json.loads(line) for line in doc["frames"]]
+        # seed the accumulator only up to the first retained frame, then
+        # run the frames through the full apply path: the MMR grows
+        # height-by-height exactly as the core's did, so the rendered
+        # payload ring (the `since` replay source) and every frame-window
+        # proof are byte-identical to what the core served at the time
+        first_frame = frames[0]["h"] if frames else int(doc["height"]) + 1
+        leaves = [bytes.fromhex(x) for x in doc["leaves"]]
+        self.light_serve.bootstrap(base, leaves[:first_frame - base])
+        for frame in frames:
+            self._apply_frame(frame)
+        with self._apply_lock:
+            self.applied_height = int(doc["height"])
+            self.snapshot_height = self.applied_height
+            if self.applied_height > self.core_tip:
+                self.core_tip = self.applied_height
+            self._set_lag()
+
+    def _rebootstrap(self) -> None:
+        """Cursor fell out of the core's retention window: rebuild the
+        serving state from a fresh snapshot (the old MMR cannot be
+        extended across a gap)."""
+        self.bootstrapped = False
+        self._set_lag()
+        self._build_serving()
+        self._bootstrap()
+        if self.env is not None:
+            self.env.light_serve = self.light_serve
+            self.env.da_serve = self.da_serve
+        self.bootstrapped = True
+
+    # -- feed tail ---------------------------------------------------------
+    def _tail_once(self) -> None:
+        url = (f"{self.core_url}/replication_feed"
+               f"?cursor={self.applied_height}"
+               f"&timeout_s={self.feed_timeout_s}")
+        with urllib.request.urlopen(
+                url, timeout=self.feed_timeout_s + 10) as resp:
+            self._resp = resp
+            self.feed_connects += 1
+            try:
+                for raw in resp:
+                    if self._stop.is_set():
+                        return
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    if "h" not in obj:  # control record: {"tip", "min"}
+                        if int(obj.get("tip", 0)) > self.core_tip:
+                            self.core_tip = int(obj["tip"])
+                        self._set_lag()
+                        continue
+                    self._apply_frame(obj)
+            finally:
+                self._resp = None
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tail_once()
+            except urllib.error.HTTPError as e:
+                if self._stop.is_set():
+                    return
+                if e.code == 409:
+                    try:
+                        self._rebootstrap()
+                    except Exception:  # noqa: BLE001 — retry after backoff
+                        self._stop.wait(0.5)
+                else:
+                    self._stop.wait(0.2)
+            except Exception:  # noqa: BLE001 — core down: reconnect loop
+                if self._stop.is_set():
+                    return
+                self._stop.wait(0.2)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        st = self.client.replication_status()
+        if st.get("role") not in (None, "core"):
+            raise RuntimeError(f"{self.core_url} is not a core feed")
+        self.chain_id = st.get("chain_id", "")
+        self.core_tip = int(st.get("tip", 0))
+        if self.sched is None:
+            from ..crypto.sched import acquire_shared
+
+            self.sched = acquire_shared(self.backend)
+        self._build_serving()
+
+        if self.forward_admission:
+            target = _ForwardTarget(self.client, self.name)
+            self.forward_target = target
+            self.pipeline = AdmissionPipeline(
+                target,
+                window=self.admission_window,
+                max_delay_s=self.admission_max_delay_s,
+                verify_sigs=True,
+                backend=self.backend,
+                sched=self.sched,
+                tenant=self.name,
+            )
+            self.pipeline.start()
+            mempool = _ReplicaMempool(self.pipeline)
+        else:
+            self.forward_target = None
+            mempool = None
+
+        self.env = Env(
+            mempool=mempool,
+            light_serve=self.light_serve,
+            da_serve=self.da_serve,
+            replication_replica=self,
+        )
+        self.rpc_server = RPCServer(
+            self.env, self.rpc_host, self.rpc_port, routes=REPLICA_ROUTES)
+        self.rpc_server.start()
+        if self.metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                host=self.metrics_host, port=self.metrics_port,
+                health_window_s=self.healthz_window_s,
+                height_fn=lambda: self.applied_height,
+                ready_fn=self.ready,
+            )
+            self.metrics_server.start()
+
+        self._set_lag()
+        if self.core_tip > 0:
+            self._bootstrap()
+        self.bootstrapped = True
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._tail_loop, daemon=True,
+            name=f"replication-tail-{self.name}")
+        self._thread.start()
+
+    def stop_tail(self) -> None:
+        """Stop consuming the feed but keep serving (failover tests kill
+        the ingest half without tearing the surfaces down)."""
+        self._stop.set()
+        resp = self._resp
+        if resp is not None:
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001
+                pass
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+
+    def resume_tail(self) -> None:
+        """Reconnect-with-cursor resume after stop_tail()."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._tail_loop, daemon=True,
+            name=f"replication-tail-{self.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.stop_tail()
+        if self.pipeline is not None:
+            self.pipeline.close()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        if self.light_serve is not None:
+            self.light_serve.stop()
+        if self.da_serve is not None:
+            self.da_serve.stop()
+        if self._own_sched and self.sched is not None:
+            from ..crypto.sched import release_shared
+
+            release_shared(self.sched)
+            self.sched = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def rpc_addr(self) -> tuple[str, int] | None:
+        return self.rpc_server.addr if self.rpc_server is not None else None
+
+    @property
+    def metrics_addr(self) -> tuple[str, int] | None:
+        return (self.metrics_server.addr
+                if self.metrics_server is not None else None)
+
+    def status(self) -> dict:
+        lag = max(0, self.core_tip - self.applied_height)
+        fwd = self.forward_target
+        return {
+            "name": self.name,
+            "chain_id": self.chain_id,
+            "core_url": self.core_url,
+            "bootstrapped": self.bootstrapped,
+            "snapshot_height": self.snapshot_height,
+            "applied_height": self.applied_height,
+            "core_tip": self.core_tip,
+            "lag_heights": lag,
+            "applied_frames": self.applied_frames,
+            "gaps": self.gaps,
+            "feed_connects": self.feed_connects,
+            "certs": dict(self.cert_kinds),
+            "forwarded_ok": fwd.forwarded_ok if fwd else 0,
+            "forwarded_rejected": fwd.forwarded_rejected if fwd else 0,
+            "forward_errors": fwd.forward_errors if fwd else 0,
+            "frames_retained": len(self.store) if self.store else 0,
+            "mmr_size": (self.light_serve.mmr.leaf_count
+                         if self.light_serve else 0),
+        }

@@ -28,12 +28,15 @@ class Env:
                  mempool=None, switch=None, event_bus=None, tx_indexer=None,
                  block_indexer=None, genesis_doc=None, app_conns=None,
                  node_info=None, evidence_pool=None, pex_reactor=None,
-                 consensus_reactor=None, light_serve=None, da_serve=None):
+                 consensus_reactor=None, light_serve=None, da_serve=None,
+                 replication_feed=None, replication_replica=None):
         self.evidence_pool = evidence_pool
         self.pex_reactor = pex_reactor
         self.consensus_reactor = consensus_reactor
         self.light_serve = light_serve
         self.da_serve = da_serve
+        self.replication_feed = replication_feed
+        self.replication_replica = replication_replica
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -946,6 +949,73 @@ def da_sample(env, params):
     }
 
 
+def _replication_feed(env):
+    feed = getattr(env, "replication_feed", None)
+    if feed is None:
+        raise RPCError(-32603, "replication feed disabled "
+                               "(config [replication] serve = false)")
+    return feed
+
+
+def replication_status(env, params):
+    """Replication-plane introspection. On a core node: feed tip,
+    retention window and subscriber count. On a serving replica: apply
+    cursor, lag, bootstrap state and forwarding counters."""
+    feed = getattr(env, "replication_feed", None)
+    if feed is not None:
+        st = feed.status()
+        st["role"] = "core"
+        return st
+    rep = getattr(env, "replication_replica", None)
+    if rep is not None:
+        st = rep.status()
+        st["role"] = "replica"
+        return st
+    raise RPCError(-32603, "replication disabled")
+
+
+def replication_snapshot(env, params):
+    """Bootstrap snapshot metadata at the current feed tip (statesync
+    Snapshot shape: height/format/chunks/hash + metadata). A joining
+    replica fetches this, then pulls chunks, verifies the hash, and
+    restores before tailing the feed."""
+    feed = _replication_feed(env)
+    try:
+        meta, _chunks = feed.snapshot()
+    except RuntimeError as e:
+        raise RPCError(-32603, str(e)) from e
+    return {
+        "height": str(meta.height),
+        "format": meta.format,
+        "chunks": meta.chunks,
+        "hash": meta.hash.hex(),
+        "metadata": _b64(meta.metadata),
+    }
+
+
+def replication_snapshot_chunk(env, params):
+    """One chunk of the bootstrap snapshot blob (b64). `height` pins the
+    snapshot the caller negotiated — a chunk from a newer rebuild must
+    not be silently spliced into an older restore."""
+    feed = _replication_feed(env)
+    try:
+        idx = int(params.get("chunk", -1))
+        want_h = int(params.get("height", 0))
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, "invalid chunk/height") from e
+    try:
+        meta, chunks = feed.snapshot()
+    except RuntimeError as e:
+        raise RPCError(-32603, str(e)) from e
+    if want_h and meta.height != want_h:
+        raise RPCError(-32603,
+                       f"snapshot moved: have {meta.height}, want {want_h}")
+    if not (0 <= idx < len(chunks)):
+        raise RPCError(-32602, f"chunk {idx} out of range [0, {len(chunks)})")
+    return {"height": str(meta.height), "chunk": idx,
+            "data": _b64(chunks[idx])}
+
+
 # unsafe operator routes, served only when rpc.unsafe is enabled
 # (reference rpc/core/routes.go AddUnsafeRoutes gated by config Unsafe)
 UNSAFE_ROUTES = {
@@ -989,4 +1059,27 @@ ROUTES = {
     "light_bisect": light_bisect,
     "da_status": da_status,
     "da_sample": da_sample,
+    "replication_status": replication_status,
+    "replication_snapshot": replication_snapshot,
+    "replication_snapshot_chunk": replication_snapshot_chunk,
+}
+
+# The stateless serving replica exposes exactly the consensus-free
+# surfaces: light streaming/proofs/bisection, DA sampling, admission
+# forwarding, and introspection. Everything else (blocks, consensus
+# state, indexers) needs stores a replica deliberately does not have.
+REPLICA_ROUTES = {
+    name: ROUTES[name]
+    for name in (
+        "health",
+        "dump_trace",
+        "light_status",
+        "light_mmr_proof",
+        "light_bisect",
+        "da_status",
+        "da_sample",
+        "broadcast_tx_sync",
+        "broadcast_tx_async",
+        "replication_status",
+    )
 }

@@ -91,6 +91,8 @@ class RPCServer:
                 params = dict(parse_qsl(u.query))
                 if method == "light_stream":
                     return self._light_stream(params)
+                if method == "replication_feed":
+                    return self._replication_feed(params)
                 # URI params arrive as "5" (quoted) or 0xABC (hex) per the
                 # reference's URI style; normalize both so handlers that
                 # do bytes.fromhex / int() see plain values. The 0x strip
@@ -145,7 +147,9 @@ class RPCServer:
                 per height, pushed as consensus commits. Optional
                 ``limit=N`` closes the stream after N payloads (load
                 generators and tests); ``timeout_s`` caps how long the
-                stream waits for the next commit (default 30 s)."""
+                stream waits for the next commit (default 30 s);
+                ``since=H`` replays retained payloads with height > H
+                before the live tail (failover cursor resume)."""
                 srv = getattr(outer.env, "light_serve", None)
                 if srv is None:
                     body = json.dumps({"error": "light serving disabled"}
@@ -153,7 +157,9 @@ class RPCServer:
                     return self._write(503, body)
                 limit = int(params.get("limit", 0) or 0)
                 timeout_s = float(params.get("timeout_s", 30.0) or 30.0)
-                sub_id, sub = srv.subscribe()
+                since = params.get("since")
+                since = int(since) if since not in (None, "") else None
+                sub_id, sub = srv.subscribe(since=since)
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -177,6 +183,66 @@ class RPCServer:
                     pass  # client went away mid-stream
                 finally:
                     srv.unsubscribe(sub_id)
+
+            # ---- replication feed ----------------------------------
+            def _replication_feed(self, params):
+                """GET /replication_feed: chunked-transfer JSONL of
+                replication frames. ``cursor=H`` resumes after height H
+                — retained frames > H replay first (gap-free), then the
+                live tail. A cursor older than the retention window gets
+                409 (the replica must re-bootstrap from the snapshot
+                surface). The first line is a control record
+                ``{"tip": T, "min": M}`` so the consumer can size its
+                catch-up lag."""
+                feed = getattr(outer.env, "replication_feed", None)
+                if feed is None:
+                    body = json.dumps({"error": "replication feed disabled"}
+                                      ).encode()
+                    return self._write(503, body)
+                from ..replication.feed import CursorTooOld
+
+                cursor = int(params.get("cursor", 0) or 0)
+                limit = int(params.get("limit", 0) or 0)
+                timeout_s = float(params.get("timeout_s", 30.0) or 30.0)
+                try:
+                    sub_id, sub, replay, tip = feed.subscribe(cursor)
+                except CursorTooOld as e:
+                    body = json.dumps({"error": str(e),
+                                       "min": e.min_height}).encode()
+                    return self._write(409, body)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/jsonl; charset=utf-8")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def _send(text: str) -> None:
+                        line = (text + "\n").encode()
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        )
+                        self.wfile.flush()
+
+                    _send(json.dumps({"tip": tip, "min": feed.min_height}))
+                    sent = 0
+                    for line in replay:
+                        _send(line)
+                        sent += 1
+                        if limit and sent >= limit:
+                            break
+                    while not limit or sent < limit:
+                        line = sub.pop(timeout=timeout_s)
+                        if line is None:
+                            break
+                        _send(line)
+                        sent += 1
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass  # replica went away mid-stream
+                finally:
+                    feed.unsubscribe(sub_id)
 
             # ---- websocket subscriptions ---------------------------
             def _websocket(self):

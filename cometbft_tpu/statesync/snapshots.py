@@ -8,8 +8,28 @@ rejection is remembered per snapshot / per format / per peer.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from dataclasses import dataclass, field
+
+# snapshot format tag for the replication-feed bootstrap blob
+# (replication/feed.py builds it, replication/replica.py restores it)
+FORMAT_REPLICATION_V1 = 1
+
+
+def blob_hash(blob: bytes) -> bytes:
+    """Snapshot content hash: what `Snapshot.hash` carries and what a
+    restorer recomputes over the reassembled chunks before trusting
+    any of the contents."""
+    return hashlib.sha256(blob).digest()
+
+
+def chunk_blob(blob: bytes, chunk_bytes: int) -> list[bytes]:
+    """Split a snapshot blob into fixed-size chunks (last one short).
+    An empty blob still yields one (empty) chunk so `Snapshot.chunks`
+    is never zero and restore loops stay uniform."""
+    n = max(1, int(chunk_bytes))
+    return [blob[i:i + n] for i in range(0, len(blob), n)] or [b""]
 
 
 @dataclass(frozen=True)

@@ -528,6 +528,35 @@ class CryptoMetrics:
             buckets=CryptoMetrics.BATCH_BUCKETS)
 
 
+class ReplicationMetrics:
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.feed_subscribers = reg.gauge(
+            "replication", "feed_subscribers",
+            "Live replication-feed subscribers (serving replicas)")
+        self.feed_frames_total = reg.counter(
+            "replication", "feed_frames_total",
+            "Frames emitted on the replication feed")
+        self.feed_bytes_total = reg.counter(
+            "replication", "feed_bytes_total",
+            "Frame bytes fanned out to feed subscribers")
+        self.feed_lag_heights = reg.gauge(
+            "replication", "feed_lag_heights",
+            "Replica apply lag behind the core tip, in heights "
+            "(readiness input for the replica /healthz)")
+        self.replica_applied_total = reg.counter(
+            "replication", "replica_applied_total",
+            "Feed frames applied into replica serving state")
+        self.replica_apply_seconds = reg.histogram(
+            "replication", "replica_apply_seconds",
+            "Per-frame replica apply latency (decode + DA re-encode + "
+            "MMR append)", buckets=TX_STAGE_BUCKETS)
+        self.forwarded_txs_total = reg.counter(
+            "replication", "forwarded_txs_total",
+            "broadcast_tx_* forwarded replica->core by tenant and outcome "
+            "(ok/rejected/error)", labels=("tenant", "outcome"))
+
+
 _BUNDLES: dict[str, object] = {}
 _BUNDLES_LOCK = threading.Lock()
 
@@ -578,6 +607,10 @@ def crypto_metrics() -> CryptoMetrics:
     return _bundle("crypto", CryptoMetrics)
 
 
+def replication_metrics() -> ReplicationMetrics:
+    return _bundle("replication", ReplicationMetrics)
+
+
 def reset_bundles() -> None:
     """Test hook: drop all bundles and empty DEFAULT_REGISTRY in place.
 
@@ -608,7 +641,11 @@ class MetricsServer:
       while consensus height has advanced within `health_window_s`
       seconds, 503 once it stalls longer than that. The server start is
       treated as an advance (grace window for boot/genesis). JSON body
-      with height / seconds-since-advance either way.
+      with height / seconds-since-advance either way. An optional
+      ``ready_fn() -> (bool, dict)`` gates readiness on top of the
+      stall check (serving replicas report 503 while snapshot-
+      bootstrapping or lagging the feed); its detail dict is merged
+      into the JSON body.
 
     Other paths get 404, other methods 405 — matching what a prometheus
     scraper expects from a metrics endpoint.
@@ -616,7 +653,8 @@ class MetricsServer:
 
     def __init__(self, registry: Registry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 health_window_s: float = 30.0, height_fn=None):
+                 health_window_s: float = 30.0, height_fn=None,
+                 ready_fn=None):
         reg = registry or DEFAULT_REGISTRY
         height_fn = height_fn or _default_height_fn
         # health state shared with handler threads: last observed height
@@ -637,10 +675,20 @@ class MetricsServer:
                     health["advanced"] = now
                 idle = now - health["advanced"]
             ok = idle <= window_s
-            return ok, {"status": "ok" if ok else "stalled",
-                        "height": h,
-                        "since_advance_s": round(idle, 3),
-                        "window_s": window_s}
+            info = {"status": "ok" if ok else "stalled",
+                    "height": h,
+                    "since_advance_s": round(idle, 3),
+                    "window_s": window_s}
+            if ready_fn is not None:
+                try:
+                    ready, detail = ready_fn()
+                except Exception:  # noqa: BLE001 — probe must not 500
+                    ready, detail = False, {"ready_error": True}
+                info.update(detail)
+                if not ready:
+                    ok = False
+                    info["status"] = "not_ready"
+            return ok, info
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):

@@ -282,6 +282,8 @@ SPAN_REGISTRY = {
     "da.encode": "one committed payload erasure-coded + committed (height/bytes/shards/shard_bytes)",
     "da.serve_sample": "one extended-chunk opening served to a sampling client (height/index)",
     "da.sample_verify": "one sample proof verified against the header's da_root (index/n/ok)",
+    "replication.feed_send": "one committed height's frame fanned out on the replication feed (height/subs/bytes)",
+    "replication.replica_apply": "one feed frame applied into replica serving state (height/da/dur_ms)",
 }
 
 

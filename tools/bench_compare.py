@@ -503,6 +503,111 @@ def compare_city(ref: str, threshold: float,
     }
 
 
+def _replicated_record(flat_src: str):
+    """The city_replicated record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        rec = data.get("city_replicated")
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+# bootstrap readiness has no recognized lower-better suffix; everything
+# else the heuristics get right (deliveries/samples per_sec higher,
+# proof p99 lower)
+_REPL_DIRECTIONS = {
+    "bootstrap.ready_s": "lower",
+}
+# non-measurement leaves: run geometry, wall-scaled counters, and the
+# correctness invariants handled first-class below (gaps/dups/mismatches
+# must stay 0 — a ratio diff over a 0 baseline is meaningless)
+_REPL_SKIP = ("gate.", "duration_s", "combined_wall_s", "clients",
+              "blocks", "replicas", "stream_groups", "stream_lines",
+              "heights_sampled", "samples_total", "clients_confident",
+              "failovers", "diff_checks", "spawned_at_height",
+              "snapshot_height", "applied_height", "forwarding.",
+              "gaps", "dups", "diff_mismatches")
+
+
+def compare_replicated(ref: str, threshold: float,
+                       relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the scale-out serving-plane workload (ISSUE 16): fleet
+    delivery/sampling throughput, proof latency, and bootstrap wall time
+    go through the directional machinery; the zero-gap/zero-mismatch
+    invariants are first-class — ANY nonzero current value is a
+    regression regardless of baseline, because the replication cursor
+    and byte-identity contracts admit no tolerance."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _replicated_record(f.read())
+    base = _replicated_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no city_replicated record on one side"}
+
+    b_flat, c_flat = _flatten(base), _flatten(cur)
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p in key for p in _REPL_SKIP):
+            continue
+        d = _REPL_DIRECTIONS.get(key) or direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    def invariant(key):
+        return {"key": key, "baseline": b_flat.get(key, 0.0),
+                "current": c_flat.get(key, 0.0),
+                "worse": c_flat.get(key, 0.0) > 0}
+
+    invariants = [invariant(k) for k in (
+        "light.gaps", "light.dups", "light.diff_mismatches",
+        "das.stream_gaps", "failover.delivery_gaps")]
+    regs = [r for r in rows if r["worse"]]
+    regs += [i for i in invariants if i["worse"]]
+    return {
+        "file": relpath, "mode": "city_replicated",
+        "invariants": invariants,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_replicated(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"city replicated: skipped ({rep['skipped']})")
+        return
+    broken = [i["key"] for i in rep["invariants"] if i["worse"]]
+    tag = "REGRESSION" if broken else "          "
+    print(f"city replicated ({rep['file']}): {tag} zero-gap/byte-identity "
+          f"invariants {'BROKEN: ' + ', '.join(broken) if broken else 'held'}")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-32s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_city(rep: dict) -> None:
     if "skipped" in rep:
         print(f"city combined: skipped ({rep['skipped']})")
@@ -597,6 +702,10 @@ def main(argv=None) -> int:
     ap.add_argument("--city", action="store_true",
                     help="also diff the city-scale combined workload "
                          "(shared-scheduler coalesce factor first-class)")
+    ap.add_argument("--replicas", action="store_true",
+                    help="also diff the scale-out serving-plane workload "
+                         "(zero-gap and byte-identity invariants "
+                         "first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -618,8 +727,10 @@ def main(argv=None) -> int:
                if args.das else None)
     city_rep = (compare_city(args.ref, args.threshold)
                 if args.city else None)
+    repl_rep = (compare_replicated(args.ref, args.threshold)
+                if args.replicas else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    for extra in (ingest_rep, bls_rep, das_rep, city_rep):
+    for extra in (ingest_rep, bls_rep, das_rep, city_rep, repl_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -633,6 +744,8 @@ def main(argv=None) -> int:
         summary["das_sampling"] = das_rep
     if city_rep is not None:
         summary["city_combined"] = city_rep
+    if repl_rep is not None:
+        summary["city_replicated"] = repl_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -660,6 +773,8 @@ def main(argv=None) -> int:
             _print_das(das_rep)
         if city_rep is not None:
             _print_city(city_rep)
+        if repl_rep is not None:
+            _print_replicated(repl_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "

@@ -34,6 +34,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -310,6 +311,183 @@ def run(clients: int, duration_s: float, k: int, m: int,
     }
 
 
+def _http_fetch(ep: str, height: int, index: int):
+    """One da_sample against `ep`, parsed into the (chunk, proof, com)
+    triple a Sampler's transport returns. None = the endpoint answered
+    but has no sample (unknown height / withheld index); transport
+    errors propagate so the caller can fail over."""
+    import base64
+
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.da.commit import DACommitment
+
+    url = f"http://{ep}/da_sample?height={height}&index={index}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 400:  # RPC-level error rides a 400 JSON body
+            return None
+        raise
+    if "error" in body:
+        return None
+    r = body["result"]
+    pr = r["proof"]
+    proof = merkle.Proof(
+        total=int(pr["total"]), index=int(pr["index"]),
+        leaf_hash=base64.b64decode(pr["leaf_hash"]),
+        aunts=[base64.b64decode(a) for a in pr["aunts"]],
+    )
+    cm = r["commitment"]
+    com = DACommitment(
+        n=int(cm["shards"]), k=int(cm["data_shards"]),
+        payload_len=int(cm["payload_len"]),
+        chunks_root=bytes.fromhex(cm["chunks_root"]),
+    )
+    return bytes.fromhex(r["chunk"]), proof, com
+
+
+def run_remote(endpoints: list[str], clients: int, duration_s: float,
+               k: int, m: int) -> dict:
+    """Multi-endpoint mode (--endpoints): sample an EXISTING serving
+    fleet (replica processes) over real HTTP instead of booting a node.
+    One /light_stream reader per endpoint discovers committed heights +
+    their da_root (reconnecting with a `since` cursor on failure, gap-
+    accounted); sampling clients pin to an endpoint round-robin and
+    fail over to the next endpoint when the pinned one dies, counting
+    per-client failovers."""
+    from cometbft_tpu.da.sampler import Sampler
+
+    n = k + m
+    n_eps = len(endpoints)
+    stop = threading.Event()
+    cursors = [0] * n_eps
+    gaps = [0] * n_eps
+    dups = [0] * n_eps
+    failovers = [0] * n_eps
+    connects = [0] * n_eps
+    roots: dict[int, bytes] = {}
+    roots_lock = threading.Lock()
+    errors: list[str] = []
+
+    def reader(g: int):
+        order = endpoints[g:] + endpoints[:g]
+        idx = 0
+        while not stop.is_set():
+            ep = order[idx % len(order)]
+            url = (f"http://{ep}/light_stream"
+                   f"?since={cursors[g]}&timeout_s={duration_s + 5}")
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=duration_s + 10) as resp:
+                    connects[g] += 1
+                    for raw in resp:
+                        if stop.is_set():
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        p = json.loads(line)
+                        h = p["height"]
+                        if h <= cursors[g]:
+                            dups[g] += 1
+                            continue
+                        if cursors[g] and h > cursors[g] + 1:
+                            gaps[g] += h - cursors[g] - 1
+                        cursors[g] = h
+                        if "da_root" in p:
+                            with roots_lock:
+                                roots[h] = bytes.fromhex(p["da_root"])
+            except Exception as e:  # noqa: BLE001 — endpoint died
+                if stop.is_set():
+                    return
+                idx += 1
+                failovers[g] += 1
+                if len(errors) < 5:
+                    errors.append(f"reader {g} @ {ep}: {e}")
+                stop.wait(0.2)
+
+    fleet = [Sampler(client_id=i, n=n, k=k, confidence=0.99, seed=1)
+             for i in range(clients)]
+    client_failovers = [0] * clients
+
+    def make_fetch(i: int):
+        def fetch(height: int, index: int):
+            for attempt in range(n_eps):
+                ep = endpoints[(i + attempt) % n_eps]
+                try:
+                    return _http_fetch(ep, height, index)
+                except Exception:  # noqa: BLE001 — fail over
+                    if attempt == 0:
+                        client_failovers[i] += 1
+                    continue
+            return None
+        return fetch
+
+    fetchers = [make_fetch(i) for i in range(clients)]
+    readers = [threading.Thread(target=reader, args=(g,), daemon=True)
+               for g in range(n_eps)]
+    t_start = time.perf_counter()
+    for t in readers:
+        t.start()
+
+    legs = []
+    last_sampled = 0
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        with roots_lock:
+            fresh = max(roots, default=0)
+            root = roots.get(fresh)
+        if not fresh or fresh <= last_sampled:
+            time.sleep(0.02)
+            continue
+        confident = 0
+        samples_ok = samples_failed = 0
+        t0 = time.perf_counter()
+        for i, s in enumerate(fleet):
+            res = s.run(fresh, root, fetchers[i])
+            samples_ok += res.samples_ok
+            samples_failed += res.samples_failed
+            if res.confident:
+                confident += 1
+        dt = time.perf_counter() - t0
+        total = samples_ok + samples_failed
+        legs.append({
+            "height": fresh,
+            "clients_confident": confident,
+            "samples": total,
+            "samples_ok": samples_ok,
+            "samples_per_sec": round(total / dt, 1) if dt else 0.0,
+        })
+        last_sampled = fresh
+
+    stop.set()
+    for t in readers:
+        t.join(timeout=5)
+    t_load = time.perf_counter() - t_start
+
+    return {
+        "metric": "das_sampling_remote",
+        "endpoints": endpoints,
+        "clients": clients,
+        "data_shards": k,
+        "parity_shards": m,
+        "duration_s": round(t_load, 2),
+        "heights_sampled": len(legs),
+        "clients_confident_min": min(
+            (leg["clients_confident"] for leg in legs), default=0),
+        "samples_total": sum(leg["samples"] for leg in legs),
+        "samples_ok": sum(leg["samples_ok"] for leg in legs),
+        "legs": legs[:3],
+        "stream_gaps": sum(gaps),
+        "stream_dups": sum(dups),
+        "stream_failovers": sum(failovers),
+        "stream_connects": sum(connects),
+        "client_failovers": sum(client_failovers),
+        "errors": errors,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=1000,
@@ -321,9 +499,17 @@ def main() -> int:
                     help="real HTTP da_sample fetches")
     ap.add_argument("--codec-mb", type=float, default=4.0,
                     help="payload MB for the native-vs-oracle encode leg")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port serving endpoints "
+                         "(replica fleet); skips booting a node")
     args = ap.parse_args()
-    res = run(args.clients, args.duration, args.data_shards,
-              args.parity_shards, args.http_samples, args.codec_mb)
+    if args.endpoints:
+        eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+        res = run_remote(eps, args.clients, args.duration,
+                         args.data_shards, args.parity_shards)
+    else:
+        res = run(args.clients, args.duration, args.data_shards,
+                  args.parity_shards, args.http_samples, args.codec_mb)
     print(json.dumps(res))
     return 0
 

@@ -270,6 +270,175 @@ def run(clients: int, duration_s: float, workers: int,
     }
 
 
+def run_remote(endpoints: list[str], clients: int, duration_s: float,
+               workers: int) -> dict:
+    """Multi-endpoint mode (--endpoints): drive an EXISTING serving
+    fleet — typically `cli.py replica` processes — instead of booting a
+    node. Logical clients pin to an endpoint round-robin; each pin
+    group shares one real /light_stream connection (a remote driver
+    cannot register in-process subscriber queues, so group fan-out is
+    the delivery accounting model) with a height cursor. On a
+    connection error the group FAILS OVER to the next endpoint and
+    reconnects with `?since=<cursor>`, so the replay window covers the
+    outage: the per-group gap counter stays 0 unless heights were truly
+    lost. Proof workers round-robin `light_mmr_proof` across endpoints
+    and differentially compare two endpoints' answers per height."""
+    from cometbft_tpu.light import verify_ancestry
+    from cometbft_tpu.rpc.client import HTTPClient
+
+    n_eps = len(endpoints)
+    groups = min(clients, n_eps) or 1
+    group_clients = [len(range(g, clients, groups)) for g in range(groups)]
+    stop = threading.Event()
+
+    base_height = None
+    for ep in endpoints:
+        try:
+            st = HTTPClient(f"http://{ep}", timeout=5).light_status()
+            base_height = int(st["base_height"])
+            break
+        except Exception:  # noqa: BLE001 — endpoint still booting
+            continue
+
+    lines = [0] * groups
+    verified = [0] * groups
+    gaps = [0] * groups
+    dups = [0] * groups
+    failovers = [0] * groups
+    connects = [0] * groups
+    cursors = [0] * groups
+    deliveries = [0]
+    dl_lock = threading.Lock()
+    errors: list[str] = []
+
+    def reader(g: int):
+        order = endpoints[g % n_eps:] + endpoints[:g % n_eps]
+        idx = 0
+        while not stop.is_set():
+            ep = order[idx % len(order)]
+            url = (f"http://{ep}/light_stream"
+                   f"?since={cursors[g]}&timeout_s={duration_s + 5}")
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=duration_s + 10) as resp:
+                    connects[g] += 1
+                    for raw in resp:
+                        if stop.is_set():
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        p = json.loads(line)
+                        h = p["height"]
+                        if h <= cursors[g]:
+                            dups[g] += 1
+                            continue
+                        if cursors[g] and h > cursors[g] + 1:
+                            gaps[g] += h - cursors[g] - 1
+                        cursors[g] = h
+                        lines[g] += 1
+                        if base_height is not None and verify_ancestry(
+                            bytes.fromhex(p["mmr_root"]), p["mmr_size"],
+                            base_height, h, bytes.fromhex(p["hash"]),
+                            bytes.fromhex(p["mmr_proof"]),
+                        ):
+                            verified[g] += 1
+                        with dl_lock:
+                            deliveries[0] += group_clients[g]
+            except Exception as e:  # noqa: BLE001 — endpoint died: fail over
+                if stop.is_set():
+                    return
+                idx += 1
+                failovers[g] += 1
+                if len(errors) < 5:
+                    errors.append(f"group {g} @ {ep}: {e}")
+                stop.wait(0.2)
+
+    proof_lat: list[float] = []
+    diff_checks = [0]
+    diff_mismatches = [0]
+    req_lock = threading.Lock()
+
+    def requester(wid: int):
+        rng = random.Random(wid)
+        cls = [HTTPClient(f"http://{ep}", timeout=10) for ep in endpoints]
+        while not stop.is_set():
+            tip = max(cursors)
+            if base_height is None or tip < base_height + 1:
+                stop.wait(0.05)
+                continue
+            h = rng.randint(base_height, tip)
+            pin = wid % n_eps
+            t0 = time.perf_counter()
+            try:
+                r = cls[pin].light_mmr_proof(height=str(h))
+            except Exception:  # noqa: BLE001 — pruned/lagging: retry
+                stop.wait(0.05)
+                continue
+            with req_lock:
+                proof_lat.append(time.perf_counter() - t0)
+            if n_eps > 1 and rng.random() < 0.25:
+                # serving-plane differential: two replicas at the SAME
+                # accumulator state must answer byte-identically; a
+                # replica mid-apply answers against a different
+                # mmr_size, which is lag, not divergence — skip it
+                other = (pin + 1 + rng.randrange(n_eps - 1)) % n_eps
+                try:
+                    r2 = cls[other].light_mmr_proof(height=str(h))
+                except Exception:  # noqa: BLE001 — lagging replica
+                    continue
+                if r.get("mmr_size") != r2.get("mmr_size"):
+                    continue
+                with req_lock:
+                    diff_checks[0] += 1
+                    if r != r2:
+                        diff_mismatches[0] += 1
+            stop.wait(0.002)
+
+    threads = [threading.Thread(target=reader, args=(g,), daemon=True)
+               for g in range(groups)]
+    threads += [threading.Thread(target=requester, args=(i,), daemon=True)
+                for i in range(workers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    stop.wait(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    t_load = time.perf_counter() - t_start
+
+    lat_ms = sorted(x * 1e3 for x in proof_lat)
+
+    def pct(p: float) -> float:
+        if not lat_ms:
+            return float("nan")
+        return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+
+    return {
+        "metric": "light_stream_remote",
+        "endpoints": endpoints,
+        "clients": clients,
+        "stream_groups": groups,
+        "duration_s": round(t_load, 2),
+        "stream_lines": sum(lines),
+        "stream_verified": sum(verified),
+        "deliveries": deliveries[0],
+        "deliveries_per_sec": round(deliveries[0] / t_load, 1),
+        "gaps": sum(gaps),
+        "dups": sum(dups),
+        "failovers": sum(failovers),
+        "connects": sum(connects),
+        "max_height_seen": max(cursors, default=0),
+        "proof_requests": len(proof_lat),
+        "proof_p50_ms": round(pct(0.50), 3),
+        "proof_p99_ms": round(pct(0.99), 3),
+        "diff_checks": diff_checks[0],
+        "diff_mismatches": diff_mismatches[0],
+        "errors": errors,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=10000,
@@ -279,8 +448,16 @@ def main() -> int:
                     help="proof/bisect request workers")
     ap.add_argument("--http-streams", type=int, default=4,
                     help="real /light_stream HTTP connections")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port serving endpoints "
+                         "(replica fleet); skips booting a node")
     args = ap.parse_args()
-    res = run(args.clients, args.duration, args.workers, args.http_streams)
+    if args.endpoints:
+        eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+        res = run_remote(eps, args.clients, args.duration, args.workers)
+    else:
+        res = run(args.clients, args.duration, args.workers,
+                  args.http_streams)
     print(json.dumps(res))
     return 0
 
