@@ -24,10 +24,46 @@ from ..storage import BlockStore
 from ..types import Commit
 from ..types.block import block_id_for
 from ..types.validation import (
+    CertCommitVerifier,
     CommitError,
     ErrInvalidSignature,
     ErrNotEnoughVotingPower,
 )
+from ..utils.metrics import blocksync_metrics
+
+
+class _WindowPending:
+    """Joined handle for one window's verification work: the ed25519
+    mega-batch plus the certificate-native commits' one-pairing checks
+    (ISSUE 17). Certificates never enter the signature mega-batch — each
+    is a single pairing regardless of signer count."""
+
+    def __init__(self, ed_pending, cert_checks):
+        self.ed = ed_pending  # ed25519 pending | None (all-cert window)
+        self.certs = cert_checks  # [(height, CertCommitVerifier, pending)]
+
+    def prefetch(self):
+        if self.ed is not None:
+            self.ed.prefetch()
+
+    def result(self):
+        """(ok, bits) of the ed25519 lanes, raising first on any failed
+        certificate with the same error taxonomy the column path uses."""
+        from ..types.validation import _raise_cert_error
+
+        m = blocksync_metrics()
+        for h, bv, pend in self.certs:
+            t0 = time.perf_counter()
+            ok, _ = pend.result()
+            m.cert_verify_seconds.observe(time.perf_counter() - t0)
+            if not ok:
+                try:
+                    _raise_cert_error(bv.error)
+                except CommitError as e:
+                    raise type(e)(f"height {h}: {e}") from e
+        if self.ed is None:
+            return True, []
+        return self.ed.result()
 
 
 @dataclass
@@ -161,8 +197,20 @@ class ReplayEngine:
 
         bv = ed25519.Ed25519BatchVerifier(backend=self.backend)
         per_commit: list[tuple[int, int, list[int]]] = []
+        cert_bvs: list[tuple[int, CertCommitVerifier]] = []
         lane = 0
         singles = 0
+        cert_sigs = 0
+
+        def queue_commit_cert(commit, vals, height):
+            """Certificate-native commit: ONE pairing check replaces the
+            whole signature column. Power tally and bitmap consistency
+            are enforced inside AggregateCommit.verify, so no per_commit
+            entry is needed — a shortfall surfaces as
+            ErrNotEnoughVotingPower through the verifier's error."""
+            nonlocal cert_sigs
+            cert_bvs.append((height, CertCommitVerifier(chain_id, vals, commit)))
+            cert_sigs += commit.signer_count()
 
         def queue_commit_columnar(commit, vals, height, all_sigs):
             """Whole-commit queueing without per-CommitSig Python: the
@@ -229,6 +277,9 @@ class ReplayEngine:
                 raise ErrInvalidCommitSize(
                     f"commit size {commit.size()} != validator set {len(vals)}"
                 )
+            if getattr(commit, "cert", None) is not None:
+                queue_commit_cert(commit, vals, height)
+                return
             if queue_commit_columnar(commit, vals, height, all_sigs):
                 return
             entries = []
@@ -276,12 +327,23 @@ class ReplayEngine:
         if commit is None:
             raise BlockValidationError(f"missing commit at height {tip}")
         queue_commit(commit, validators, prev_bid, tip, all_sigs=False)
+        cert_checks = []
         if self.sched is not None:
-            pending = self.sched.submit(
-                bv, tenant=self.tenant, source="blocksync")
+            for ch, cbv in cert_bvs:
+                cert_checks.append(
+                    (ch, cbv, self.sched.submit(
+                        cbv, tenant=self.tenant, source="blocksync"))
+                )
+            ed_pending = (
+                self.sched.submit(bv, tenant=self.tenant, source="blocksync")
+                if bv.count() else None
+            )
         else:
-            pending = bv.submit()
-        return pending, per_commit, lane + singles
+            for ch, cbv in cert_bvs:
+                cert_checks.append((ch, cbv, cbv.submit()))
+            ed_pending = bv.submit() if bv.count() else None
+        pending = _WindowPending(ed_pending, cert_checks)
+        return pending, per_commit, lane + singles + cert_sigs
 
     def _light_check_window(self, state, blocks: list) -> int:
         """Synchronous window check (submit + resolve); kept for callers

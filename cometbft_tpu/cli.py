@@ -127,6 +127,11 @@ def cmd_testnet(args) -> int:
 
     n_seeds = getattr(args, "seed_nodes", 0)
     total = args.v + n_seeds
+    key_type = getattr(args, "key_type", "ed25519")
+    pv_key_type = (
+        "tendermint/PubKeyBls12_381" if key_type == "bls"
+        else "tendermint/PubKeyEd25519"
+    )
     pvs = []
     homes = []
     for i in range(total):
@@ -134,13 +139,20 @@ def cmd_testnet(args) -> int:
         p = _cfg_paths(home)
         os.makedirs(p["config"], exist_ok=True)
         os.makedirs(p["data"], exist_ok=True)
-        pvs.append(FilePV.generate(p["pv_key"], p["pv_state"]))
+        pvs.append(FilePV.generate(p["pv_key"], p["pv_state"],
+                                   key_type=pv_key_type))
         homes.append(home)
     gd = GenesisDoc(
         chain_id=args.chain_id,
         genesis_time=Timestamp.from_unix_ns(time.time_ns()),
         validators=[
-            GenesisValidator(pv.pub_key().bytes(), 10, f"node{i}")
+            GenesisValidator(
+                pv.pub_key().bytes(), 10, f"node{i}",
+                pub_key_type=pv_key_type,
+                # BLS genesis entries carry a proof of possession (rogue
+                # -key defense — validated by GenesisDoc.validate_basic)
+                pop=pv._priv.pop() if key_type == "bls" else b"",
+            )
             for i, pv in enumerate(pvs[:args.v])
         ],
     )
@@ -535,6 +547,11 @@ def main(argv=None) -> int:
     sp.add_argument("--output", default="./testnet")
     sp.add_argument("--chain-id", default="testnet-chain")
     sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--key-type", default="ed25519",
+                    choices=("ed25519", "bls"),
+                    help="validator consensus key curve; bls enables "
+                         "certificate-native commits (genesis carries "
+                         "possession proofs)")
     sp.set_defaults(fn=cmd_testnet)
     sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)

@@ -174,6 +174,13 @@ class ConsensusConfig:
     # consumes it only if (height, last-commit, state, mempool) still
     # match, else discards bit-safely and rebuilds cold
     speculative_propose: bool = True
+    # certificate-native consensus (ISSUE 17): on all-BLS validator
+    # sets, precommits adopt the proposal timestamp so +2/3 folds into
+    # ONE aggregate certificate — gossiped to lagging peers as a single
+    # frame, embedded as the block's LastCommit, and stored canonically.
+    # Mixed/ed25519 sets never fold, so wire and store bytes stay
+    # identical to the pre-certificate format regardless of this flag.
+    cert_native: bool = True
 
     def validate(self) -> None:
         for name in ("timeout_propose", "timeout_prevote", "timeout_precommit",
@@ -235,6 +242,14 @@ class StateSyncConfig:
 @dataclass
 class StorageConfig:
     discard_abci_responses: bool = False
+    # heights of full signature columns kept beside a certificate-native
+    # canonical seen commit (evidence window; ISSUE 17) — older columns
+    # are dropped, the certificate remains verifiable forever
+    full_commit_window: int = 64
+
+    def validate(self) -> None:
+        if self.full_commit_window < 0:
+            raise ValueError("storage.full_commit_window must be >= 0")
 
 
 @dataclass
@@ -436,8 +451,8 @@ class Config:
     def validate(self) -> None:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
-                        self.light, self.da, self.replication, self.sched,
-                        self.instrumentation):
+                        self.storage, self.light, self.da, self.replication,
+                        self.sched, self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------

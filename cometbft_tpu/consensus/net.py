@@ -79,13 +79,15 @@ class InProcessNetwork:
 
     def __init__(self, n: int, tmpdir: str, chain_id: str = "loop-chain",
                  timeouts: TimeoutConfig = FAST_TIMEOUTS, power: int = 10,
-                 consensus_params=None, app_factory=None):
+                 consensus_params=None, app_factory=None,
+                 key_type: str = "tendermint/PubKeyEd25519"):
         self.chain_id = chain_id
         self.app_factory = app_factory
         self.pvs = [
             FilePV.generate(
                 os.path.join(tmpdir, f"pv{i}.key.json"),
                 os.path.join(tmpdir, f"pv{i}.state.json"),
+                key_type=key_type,
             )
             for i in range(n)
         ]

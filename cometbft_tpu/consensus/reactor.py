@@ -40,8 +40,9 @@ from ..types.vote import SignedMsgType
 from ..utils import trace
 from ..utils.log import logger
 from ..utils.metrics import p2p_metrics
+from ..types.agg_commit import AggregateCommit
 from .state import ConsensusState, ProposalMessage, RoundStep, VoteMessage
-from .wal import BlockBytesMessage
+from .wal import AggregateCommitMessage, BlockBytesMessage
 
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
@@ -207,6 +208,10 @@ def encode_consensus_msg(msg) -> bytes:
             + pb.f_embedded(3, msg.psh.encode())
             + (pb.f_varint(4, 1) if msg.is_commit else b""),
         )
+    if isinstance(msg, AggregateCommitMessage):
+        # one +2/3 aggregate-precommit certificate (ISSUE 17): replaces
+        # the N per-vote frames of catchup gossip on BLS validator sets
+        return pb.f_embedded(10, msg.cert.encode())
     if isinstance(msg, VoteSetBitsMessage):
         # bitmap travels as little-endian bytes: a varint caps out at 63
         # validators, real sets are larger (reference BitArray proto)
@@ -283,6 +288,8 @@ def decode_consensus_msg(buf: bytes):
             PartSetHeader.decode(pb.as_bytes(d.get(3, b""))),
             bool(pb.to_i64(d.get(4, 0))),
         )
+    if fnum == 10:
+        return AggregateCommitMessage(AggregateCommit.decode(v))
     raise ValueError(f"unknown consensus message tag {fnum}")
 
 
@@ -302,7 +309,7 @@ def decode_consensus_msg(buf: bytes):
 _WIRE_MSG_KINDS = {
     1: "vote", 2: "proposal", 3: "block_bytes", 4: "new_round_step",
     6: "block_part", 7: "vote_set_maj23",
-    8: "vote_set_bits", 9: "new_valid_block",
+    8: "vote_set_bits", 9: "new_valid_block", 10: "agg_commit",
 }
 _VOTE_TYPE_NAMES = {1: "prevote", 2: "precommit", 32: "proposal"}
 # Mempool channel id duplicated here (mempool/reactor.py) to keep the
@@ -394,6 +401,22 @@ class PeerState:
         self.last_step_send = 0.0  # periodic NewRoundStep re-send
         # (height, round, type) -> set of validator indexes known to peer
         self.votes_seen: dict[tuple[int, int, int], set[int]] = {}
+        # height -> monotonic time an AggregateCommit frame was last
+        # sent (ISSUE 17): one certificate replaces the whole vote
+        # column, so re-sends are time-gated instead of bitmap-diffed
+        self.certs_sent: dict[int, float] = {}
+
+    def mark_cert_sent(self, height: int, now: float,
+                       resend_s: float) -> bool:
+        """True when a certificate for `height` should be sent now (and
+        records the send); False inside the re-send window."""
+        with self.lock:
+            if now - self.certs_sent.get(height, -1e9) < resend_s:
+                return False
+            self.certs_sent[height] = now
+            while len(self.certs_sent) > 8:
+                self.certs_sent.pop(next(iter(self.certs_sent)))
+        return True
 
     def apply_new_round_step(self, m: NewRoundStepMessage) -> None:
         with self.lock:
@@ -456,6 +479,7 @@ class ConsensusReactor(Reactor):
 
     GOSSIP_SLEEP_S = 0.01
     PEER_QUERY_MAJ23_INTERVAL_S = 2.0
+    CERT_RESEND_S = 2.0  # AggregateCommit re-send window per height
     # bounds on attacker-controlled buffers
     MAX_PART_INDEX = 2047  # parts per block (128 MiB at 64 KiB parts)
     MAX_HEADERLESS_PARTS = 256  # buffered before the proposal arrives
@@ -484,6 +508,10 @@ class ConsensusReactor(Reactor):
         # re-merkleizations, and one vote send must not rebuild the list)
         self._catchup_cache: dict[int, PartSet] = {}
         self._catchup_votes: dict[int, tuple] = {}
+        # height -> AggregateCommit | None (ISSUE 17): the stored
+        # commit's certificate when the height committed cert-natively,
+        # so lagging peers get ONE frame instead of the vote column
+        self._catchup_certs: dict[int, object] = {}
         # height-keyed assembly of a known-valid block (catchup path):
         # headers arrive via NewValidBlock, parts verified against them.
         # Multiple candidates per height, bounded: a forged header from
@@ -626,6 +654,10 @@ class ConsensusReactor(Reactor):
                     and 0 < msg.psh.total <= self.MAX_PART_INDEX + 1
                 ):
                     self._vb_candidates[key] = (msg.psh, {})
+        elif isinstance(msg, AggregateCommitMessage):
+            # one-pairing verification happens in the state machine
+            # (scheduler-routed), not on the p2p receive thread
+            self.cs.send(msg, peer_id=peer.id)
         elif isinstance(msg, BlockBytesMessage):
             # legacy whole-block message: still accepted (tests, tools)
             self.cs.send(msg, peer_id=peer.id)
@@ -948,6 +980,41 @@ class ConsensusReactor(Reactor):
                 return True
         return False
 
+    def _cert_for_height(self, height: int):
+        """The stored commit's AggregateCommit for a cert-native height
+        (None when the height committed with a signature column). Cached
+        beside the catchup PartSets."""
+        with self._lock:
+            if height in self._catchup_certs:
+                return self._catchup_certs[height]
+        store = self.block_store
+        cert = None
+        if store is not None:
+            commit = store.load_block_commit(height) \
+                or store.load_seen_commit(height)
+            cert = getattr(commit, "cert", None)
+        with self._lock:
+            self._catchup_certs[height] = cert
+            while len(self._catchup_certs) > self.CATCHUP_CACHE_SIZE:
+                self._catchup_certs.pop(next(iter(self._catchup_certs)))
+        return cert
+
+    def _maybe_send_cert(self, ps: PeerState, height: int) -> bool:
+        """Certificate-native catchup (ISSUE 17): send ONE
+        AggregateCommit frame for `height` instead of gossiping the vote
+        column, time-gated per (peer, height) for re-delivery."""
+        cert = self._cert_for_height(height)
+        if cert is None:
+            return False
+        if not ps.mark_cert_sent(height, time.monotonic(),
+                                 self.CERT_RESEND_S):
+            return False
+        ps.peer.send(
+            VOTE_CHANNEL,
+            encode_consensus_msg(AggregateCommitMessage(cert)),
+        )
+        return True
+
     def _commit_as_voteset(self, height: int):
         """Stored commit -> precommit votes for catchup gossip (reference
         gossipVotesRoutine LoadCommit path). Cached per height beside the
@@ -964,6 +1031,10 @@ class ConsensusReactor(Reactor):
             height
         )
         if commit is None:
+            return None
+        if getattr(commit, "cert", None) is not None:
+            # cert-native commit: per-validator signatures are gone from
+            # the store — catchup is served by _maybe_send_cert instead
             return None
         votes = []
         for idx, csig in enumerate(commit.signatures):
@@ -1015,8 +1086,12 @@ class ConsensusReactor(Reactor):
                 return True
             return False
         if h == cs.height - 1 and cs.last_commit is not None:
+            if self._maybe_send_cert(ps, h):
+                return True
             return self._pick_send_vote(ps, cs.last_commit)
         if h < cs.height - 1:
+            if self._maybe_send_cert(ps, h):
+                return True
             got = self._commit_as_voteset(h)
             if got is None:
                 return False

@@ -52,7 +52,13 @@ from ..types.vote import SignedMsgType
 from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
 from .height_vote_set import HeightVoteSet
 from .ticker import TimeoutInfo, TimeoutTicker
-from .wal import BlockBytesMessage, MsgInfo, TimeoutMessage, WAL
+from .wal import (
+    AggregateCommitMessage,
+    BlockBytesMessage,
+    MsgInfo,
+    TimeoutMessage,
+    WAL,
+)
 
 
 class RoundStep(enum.IntEnum):
@@ -114,6 +120,46 @@ class _SpeculativeProposal:
     block_id: BlockID
 
 
+class _CertVoteSetShim:
+    """Stand-in for the last-commit VoteSet after a restart whose stored
+    seen commit is certificate-native (ISSUE 17): the per-validator
+    signatures are unrecoverable from the BLS aggregate, so this quacks
+    just enough of VoteSet — catchup gossip and proposal embedding read
+    the commit back via make_commit(); vote accounting and per-index
+    queries degrade to no-ops."""
+
+    signed_msg_type = SignedMsgType.PRECOMMIT
+
+    def __init__(self, height: int, cert_commit, val_set):
+        self.height = height
+        self.round = cert_commit.round
+        self.val_set = val_set
+        self._cc = cert_commit
+
+    def make_commit(self):
+        return self._cc
+
+    def add_vote(self, vote, peer_id: str = "") -> bool:
+        return False
+
+    def size(self) -> int:
+        return self._cc.size()
+
+    def bit_array(self):
+        from ..utils.bits import BitArray
+
+        return BitArray(self._cc.size())  # all clear: no votes to gossip
+
+    def get_by_index(self, idx: int):
+        return None
+
+    def two_thirds_majority(self):
+        return self._cc.block_id, True
+
+    def has_two_thirds_any(self) -> bool:
+        return True
+
+
 class ConsensusState:
     """One validator's consensus engine over an in-process transport."""
 
@@ -133,6 +179,7 @@ class ConsensusState:
         ticker_factory=None,
         speculative: bool = False,
         mempool_version=None,
+        cert_native: bool = True,
     ):
         self.chain_id = chain_id
         self.sm_state = sm_state
@@ -151,6 +198,10 @@ class ConsensusState:
         # staleness probe the consume seam checks (CListMempool.version)
         self.speculative = speculative
         self.mempool_version = mempool_version or (lambda: 0)
+        # certificate-native consensus (ISSUE 17): fold +2/3 BLS
+        # precommits into one AggregateCommit for gossip, storage and
+        # proposal embedding. Inert on non-BLS validator sets.
+        self.cert_native = cert_native
         self._spec_lock = threading.Lock()
         self._spec_thread: threading.Thread | None = None
         self._spec: _SpeculativeProposal | None = None
@@ -212,6 +263,13 @@ class ConsensusState:
         if seen is None:
             return
         vals = self.sm_state.last_validators
+        if getattr(seen, "cert", None) is not None:
+            # certificate-native seen commit: the per-validator
+            # signatures are unrecoverable from the aggregate, so stand
+            # in a shim that serves the commit back (catchup gossip,
+            # proposal embedding) and no-ops vote accounting
+            self.last_commit = _CertVoteSetShim(h, seen, vals)
+            return
         vs = VoteSet(self.chain_id, h, seen.round, SignedMsgType.PRECOMMIT, vals)
         for idx, cs in enumerate(seen.signatures):
             if cs.is_absent():
@@ -351,6 +409,8 @@ class ConsensusState:
             )
         elif isinstance(msg, BlockBytesMessage):
             self._handle_block_bytes(msg, peer_id)
+        elif isinstance(msg, AggregateCommitMessage):
+            self._handle_cert(msg, peer_id)
         else:
             raise TypeError(f"unknown consensus message {type(msg)}")
 
@@ -521,18 +581,87 @@ class ConsensusState:
             self.enter_prevote(self.height, self.round)
 
     def _after_precommit(self, v: Vote) -> None:
-        precommits = self.votes.precommits(v.round)
+        self._check_precommit_progress(v.round)
+
+    def _check_precommit_progress(self, r: int) -> None:
+        """Drive step transitions off round r's precommit set — shared by
+        per-vote accounting and certificate application (ISSUE 17)."""
+        precommits = self.votes.precommits(r)
         maj, ok = precommits.two_thirds_majority()
         if ok:
-            self.enter_new_round(self.height, v.round)
-            self.enter_precommit(self.height, v.round)
+            self.enter_new_round(self.height, r)
+            self.enter_precommit(self.height, r)
             if not maj.is_zero():
-                self.enter_commit(self.height, v.round)
+                self.enter_commit(self.height, r)
             else:
-                self.enter_precommit_wait(self.height, v.round)
-        elif self.round <= v.round and precommits.has_two_thirds_any():
-            self.enter_new_round(self.height, v.round)
-            self.enter_precommit_wait(self.height, v.round)
+                self.enter_precommit_wait(self.height, r)
+        elif self.round <= r and precommits.has_two_thirds_any():
+            self.enter_new_round(self.height, r)
+            self.enter_precommit_wait(self.height, r)
+
+    def _handle_cert(self, msg: AggregateCommitMessage, peer_id: str) -> None:
+        """One +2/3 aggregate-precommit certificate from catchup gossip
+        (ISSUE 17): replaces N vote frames for a lagging node. Verified
+        with ONE pairing (through the shared VerifyScheduler when the
+        executor has one), then folded into the height-vote-set so the
+        ordinary precommit progress rules fire."""
+        cert = msg.cert
+        m = consensus_metrics()
+        if not self.cert_native:
+            m.cert_gossip_total.inc(1.0, "disabled")
+            return
+        if cert.height != self.height:
+            m.cert_gossip_total.inc(1.0, "stale")
+            return
+        if not self.validators.all_bls():
+            m.cert_gossip_total.inc(1.0, "non_bls")
+            return
+        self.votes._ensure_round(cert.round)
+        vs = self.votes.precommits(cert.round)
+        if vs.cert is not None:
+            m.cert_gossip_total.inc(1.0, "dup")
+            return
+        _, ok = vs.two_thirds_majority()
+        if ok:
+            # vote gossip already reached quorum on its own
+            m.cert_gossip_total.inc(1.0, "redundant")
+            return
+        from ..types.agg_commit import CertCommit
+        from ..types.validation import CertCommitVerifier
+
+        bv = CertCommitVerifier(
+            self.chain_id, self.validators,
+            CertCommit(cert, len(self.validators)),
+        )
+        sched = getattr(self.executor, "verify_sched", None)
+        t0 = time.perf_counter()
+        if sched is not None:
+            verified, _ = sched.submit(
+                bv, self.executor.sched_tenant, "consensus"
+            ).result()
+        else:
+            verified, _ = bv.verify()
+        if trace.enabled:
+            trace.emit(
+                "consensus.cert_aggregate", "span",
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                height=cert.height, round=cert.round,
+                signers=cert.signer_count(),
+                outcome="verified" if verified else "invalid",
+            )
+        if not verified:
+            m.cert_gossip_total.inc(1.0, "invalid")
+            return  # bad peer certificate: drop (punishment at p2p layer)
+        try:
+            added = vs.apply_certificate(cert)
+        except Exception:
+            m.cert_gossip_total.inc(1.0, "invalid")
+            return
+        if not added:
+            m.cert_gossip_total.inc(1.0, "dup")
+            return
+        m.cert_gossip_total.inc(1.0, "applied")
+        self._check_precommit_progress(cert.round)
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         # reference handleTimeout (state.go:982)
@@ -674,7 +803,15 @@ class ConsensusState:
         if self.height == self.sm_state.initial_height:
             return Commit()
         assert self.last_commit is not None, "no last commit at height > initial"
-        return self.last_commit.make_commit()
+        commit = self.last_commit.make_commit()
+        if self.cert_native:
+            # fold the +2/3 precommit column into one BLS certificate so
+            # the proposed block embeds it natively (ISSUE 17) — no-op
+            # for non-BLS/mixed sets or non-uniform timestamps
+            from ..types.agg_commit import fold_commit
+
+            commit = fold_commit(commit, self.sm_state.last_validators)
+        return commit
 
     # ------------------------------------------------------------------
     # speculative proposal assembly (ISSUE 11)
@@ -705,7 +842,7 @@ class ConsensusState:
             return
         h = self.height
         state = self.sm_state
-        last_commit = self.last_commit.make_commit()
+        last_commit = self._last_commit_for_proposal()
         mv = self.mempool_version()
         proposer_addr = self.privval.address()
 
@@ -926,7 +1063,20 @@ class ConsensusState:
         precommits = self.votes.precommits(self.commit_round)
         seen_commit = precommits.make_commit()
         if self.block_store is not None:
-            self.block_store.save_block(block, seen_commit)
+            store_seen = seen_commit
+            full_seen = None
+            if self.cert_native:
+                # persist the certificate as the canonical seen commit;
+                # the full column rides along so the store can keep it
+                # in its recent evidence window (ISSUE 17)
+                from ..types.agg_commit import fold_commit
+
+                store_seen = fold_commit(seen_commit, self.validators)
+                if store_seen is not seen_commit:
+                    full_seen = seen_commit
+            self.block_store.save_block(
+                block, store_seen, full_seen_commit=full_seen
+            )
             if self.extensions_enabled(h):
                 self.block_store.save_extended_commit(
                     precommits.make_extended_commit()
@@ -1000,12 +1150,28 @@ class ConsensusState:
         idx, val = self.validators.get_by_address(self.privval.address())
         if val is None:
             return
+        bid = block_id or BlockID()
+        ts = Timestamp.from_unix_ns(self.now_ns())
+        if (
+            self.cert_native
+            and vtype == SignedMsgType.PRECOMMIT
+            and not bid.is_zero()
+            and self.proposal is not None
+            and self.proposal.round == self.round
+            and self.validators.all_bls()
+        ):
+            # PBTS-style uniform precommit timestamp (ISSUE 17): every
+            # correct validator precommitting this proposal signs the
+            # proposer's timestamp, so the +2/3 commit folds into one
+            # BLS certificate. A validator missing the proposal signs
+            # its own time; the fold then falls back to the full column.
+            ts = self.proposal.timestamp
         vote = Vote(
             type=vtype,
             height=self.height,
             round=self.round,
-            block_id=block_id or BlockID(),
-            timestamp=Timestamp.from_unix_ns(self.now_ns()),
+            block_id=bid,
+            timestamp=ts,
             validator_address=val.address,
             validator_index=idx,
         )

@@ -57,6 +57,16 @@ class BlockBytesMessage:
 
 
 @dataclass
+class AggregateCommitMessage:
+    """Certificate-native catchup gossip (ISSUE 17): one verified +2/3
+    aggregate-precommit certificate replacing N vote frames. Defined
+    here beside BlockBytesMessage so the WAL can frame it without
+    importing the state machine."""
+
+    cert: object  # types.agg_commit.AggregateCommit
+
+
+@dataclass
 class TimeoutMessage:
     height: int
     round: int
@@ -86,6 +96,8 @@ def _encode_msg(m) -> bytes:
                 + pb.f_varint(2, inner.round)
                 + pb.f_bytes(3, inner.block_bytes),
             )
+        elif isinstance(inner, AggregateCommitMessage):
+            body = pb.f_embedded(4, inner.cert.encode())
         else:
             raise TypeError(f"unsupported WAL MsgInfo payload {type(inner)}")
         return pb.f_embedded(3, body + pb.f_string(15, m.peer_id))
@@ -130,6 +142,13 @@ def _decode_msg_field(fnum: int, v: bytes):
                     pb.to_i64(bd.get(2, 0)),
                     pb.as_bytes(bd.get(3, b"")),
                 ),
+                peer,
+            )
+        if 4 in d:
+            from ..types.agg_commit import AggregateCommit
+
+            return MsgInfo(
+                AggregateCommitMessage(AggregateCommit.decode(pb.as_bytes(d[4]))),
                 peer,
             )
         raise ValueError("unknown MsgInfo payload")

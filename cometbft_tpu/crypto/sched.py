@@ -353,6 +353,27 @@ class VerifyScheduler:
                         per_tenant_sigs={req.tenant: req.n})
                 _resolve(req.future, (ok, bits))
                 return
+            # non-coalescable verifiers (certificate one-pairing checks,
+            # ISSUE 17) dispatch individually inside this drain cycle;
+            # only ed25519-absorbing verifiers share the mega-batch
+            solo = [r for r in batch
+                    if not getattr(r.bv, "coalescable", True)]
+            batch = [r for r in batch
+                     if getattr(r.bv, "coalescable", True)]
+            for req in solo:
+                self.stats["dispatches"] += 1
+                self.stats["passthrough"] += 1
+                m.sched_batch_sigs.observe(req.n)
+                _resolve(req.future, req.bv.verify())
+            if not batch:
+                return
+            if len(batch) == 1:
+                req = batch[0]
+                self.stats["dispatches"] += 1
+                self.stats["passthrough"] += 1
+                m.sched_batch_sigs.observe(req.n)
+                _resolve(req.future, req.bv.verify())
+                return
             mega = _ed.Ed25519BatchVerifier(backend=self.backend)
             ranges: list[tuple[int, int]] = []
             per_tenant: dict[str, int] = {}

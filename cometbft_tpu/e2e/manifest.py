@@ -71,6 +71,11 @@ class Manifest:
     # commitment in the header (config [da]); the runner's invariant
     # check then verifies da_root consistency across the stores
     da_enabled: bool = False
+    # validator consensus-key curve: "bls" runs the net certificate-
+    # native (aggregate precommit gossip + CertCommit storage, ISSUE
+    # 17); the runner then re-derives every stored certificate against
+    # the validator set as an extra invariant
+    key_type: str = "ed25519"
 
     @classmethod
     def parse(cls, d: dict) -> "Manifest":
@@ -89,6 +94,7 @@ class Manifest:
                 d.get("vote_extensions_enable_height", 0)
             ),
             da_enabled=bool(d.get("da_enabled", False)),
+            key_type=d.get("key_type", "ed25519"),
         )
 
 
@@ -162,4 +168,8 @@ def generate_manifest(seed: int, target_height: int = 10) -> Manifest:
         # half the generated nets run with DA commitments in the
         # header — consensus must be byte-compatible either way
         da_enabled=rng.random() < 0.5,
+        # a third of the nets sign with BLS keys: gossip, blocks and
+        # stores run certificate-native end to end (ISSUE 17) and the
+        # runner re-derives every stored certificate post-run
+        key_type=rng.choice(["ed25519", "ed25519", "bls"]),
     )

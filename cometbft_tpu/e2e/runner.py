@@ -177,6 +177,7 @@ class Runner:
             "--output", self.workdir,
             "--chain-id", m.chain_id,
             "--starting-port", str(self.starting_port),
+            "--key-type", m.key_type,
         ])
         if rc != 0:
             raise E2EError("testnet generation failed")
@@ -658,6 +659,16 @@ class Runner:
             from ..da import DAServe
 
             da_check = DAServe(DAConfig(enabled=True))
+        cert_vals = None
+        certs_checked = 0
+        if self.manifest.key_type == "bls":
+            # the e2e valset is static (KVStore app emits no updates):
+            # the genesis set verifies every height's certificate
+            from ..types.genesis import GenesisDoc
+
+            gpath = os.path.join(
+                self.workdir, "node0", "config", "genesis.json")
+            cert_vals = GenesisDoc.load(gpath).validator_set()
         chains: dict[str, dict[int, tuple[bytes, bytes]]] = {}
         da_roots_checked = 0
         for name, n in self.nodes.items():
@@ -677,6 +688,29 @@ class Runner:
                                 "not re-derive from the stored payload"
                             )
                         da_roots_checked += 1
+                if cert_vals is None:
+                    continue
+                # certificate re-derivation (ISSUE 17): every stored
+                # commit on a BLS net must be certificate-native and its
+                # one-pairing aggregate must verify against the valset
+                for commit in (bs.load_block_commit(h),
+                               bs.load_seen_commit(h)):
+                    if commit is None or commit.height == 0:
+                        continue  # genesis empty commit / not stored
+                    cert = getattr(commit, "cert", None)
+                    if cert is None:
+                        raise E2EError(
+                            f"{name} height {h}: BLS net stored a plain "
+                            "signature column, not a certificate"
+                        )
+                    try:
+                        cert.verify(self.manifest.chain_id, cert_vals)
+                    except Exception as e:
+                        raise E2EError(
+                            f"{name} height {h}: stored certificate "
+                            f"does not re-verify: {e}"
+                        ) from e
+                    certs_checked += 1
             chains[name] = by_h
         heights = [max(c) if c else 0 for c in chains.values()]
         if not heights or max(heights) < self.manifest.target_height:
@@ -701,6 +735,10 @@ class Runner:
         }
         if da_check is not None:
             out["da_roots_checked"] = da_roots_checked
+        if cert_vals is not None:
+            if certs_checked == 0:
+                raise E2EError("BLS net stored no certificates to check")
+            out["certs_checked"] = certs_checked
         return out
 
     def check_abci_grammar(self) -> dict:

@@ -275,6 +275,12 @@ class LightServe:
             "mmr_root": self.mmr.root().hex().upper(),
             "mmr_proof": proof.encode().hex(),
         }
+        seen = self.block_store.load_seen_commit(header.height)
+        cert = getattr(seen, "cert", None) if seen is not None else None
+        if cert is not None:
+            # cert-native chain (ISSUE 17): ship the aggregate so stream
+            # consumers verify the height with one pairing, no re-fetch
+            payload["cert"] = cert.encode().hex()
         if self.da_serve is not None:
             # DA commit hook runs before this one (node wiring order), so
             # the height's commitment is already encoded and retained
@@ -358,6 +364,25 @@ class LightServe:
         num, den = self.trust_level
         total = trusted_next.total_voting_power()
         tallied, seen = 0, set()
+        cert = getattr(commit, "cert", None)
+        if cert is not None:
+            # cert-native commit: addresses come from the signing set at
+            # the candidate height (the bitmap indexes it), not from the
+            # signature column (which a certificate no longer carries)
+            signing = self.state_store.load_validators(candidate)
+            if signing is None or commit.size() != len(signing):
+                return False
+            for idx in range(len(signing)):
+                if not cert.has_signer(idx):
+                    continue
+                addr = signing.get_by_index(idx).address
+                if addr in seen:
+                    continue
+                seen.add(addr)
+                _, val = trusted_next.get_by_address(addr)
+                if val is not None:
+                    tallied += val.voting_power
+            return tallied > total * num // den
         for cs in commit.signatures:
             if not cs.is_commit():
                 continue

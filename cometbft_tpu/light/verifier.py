@@ -23,6 +23,7 @@ from ..types import Timestamp, ValidatorSet
 from ..types.validation import (
     ErrInvalidSignature,
     ErrNotEnoughVotingPower,
+    verify_cert_trusting,
     verify_commit_light,
     verify_commit_light_trusting,
 )
@@ -110,6 +111,21 @@ def verify_non_adjacent(
     _check_trusted_age(trusted, trusting_period_s, now)
     untrusted.basic_validate(chain_id)
     _validate_header(trusted, untrusted, now, max_clock_drift_s)
+    if getattr(untrusted.commit, "cert", None) is not None:
+        # Certificate-native pivot: ONE pairing covers both the
+        # trust-level tally (bitmap signers scored against the trusted
+        # set by address) and the +2/3 check against the signing set.
+        # A power shortfall from either check triggers bisection; an
+        # actually-bogus certificate still hard-fails once bisection
+        # reaches the adjacent step.
+        try:
+            verify_cert_trusting(
+                chain_id, trusted_next_vals, untrusted_vals,
+                untrusted.commit, trust_level=trust_level, backend=backend,
+            )
+        except (ErrNotEnoughVotingPower,) as e:
+            raise ErrNewValSetCantBeTrusted(str(e)) from e
+        return
     try:
         verify_commit_light_trusting(
             chain_id, trusted_next_vals, untrusted.commit,
@@ -183,6 +199,16 @@ def verify_stream(
         vals = lb.validators
         if sh.commit.size() != len(vals):
             raise ErrInvalidHeader(f"commit size mismatch at {sh.header.height}")
+        if getattr(sh.commit, "cert", None) is not None:
+            # certificate-native header: one pairing stands in for this
+            # header's signature lanes (a BLS pairing cannot join the
+            # ed25519 mega-batch)
+            verify_commit_light(
+                chain_id, vals, sh.commit.block_id, sh.header.height,
+                sh.commit, backend=backend,
+            )
+            prev = lb
+            continue
         tally = 0
         for idx, cs in enumerate(sh.commit.signatures):
             if not cs.is_commit():

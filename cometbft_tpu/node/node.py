@@ -86,7 +86,8 @@ class Node:
         # --- stores ----------------------------------------------------
         mem = config.base.db_backend == "mem"
         self.block_store = BlockStore(
-            open_kv(None if mem else _p("data/blockstore.db"))
+            open_kv(None if mem else _p("data/blockstore.db")),
+            full_commit_window=config.storage.full_commit_window,
         )
         self.state_store = StateStore(
             open_kv(None if mem else _p("data/state.db"))
@@ -322,6 +323,7 @@ class Node:
             name=config.base.moniker,
             speculative=config.consensus.speculative_propose,
             mempool_version=lambda: self.mempool.version,
+            cert_native=config.consensus.cert_native,
         )
 
         # --- p2p -------------------------------------------------------

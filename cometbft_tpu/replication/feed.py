@@ -92,6 +92,11 @@ class ReplicationFeed:
         cache's verdict for the height, else pending (the replica
         verifies lazily through its own cache, same resolution rules)."""
         if commit is not None:
+            cert = getattr(commit, "cert", None)
+            if cert is not None:
+                # cert-native store (ISSUE 17): the seen commit IS the
+                # certificate — no fold needed, reuse its aggregate
+                return {"kind": "cert_native", "data": cert.encode().hex()}
             try:
                 from ..types.agg_commit import AggregateCommit
 

@@ -48,7 +48,8 @@ from ..rpc.client import HTTPClient
 from ..rpc.routes import Env, REPLICA_ROUTES
 from ..rpc.server import RPCServer
 from ..statesync.snapshots import Snapshot, SnapshotPool, blob_hash
-from ..types import Commit, Header
+from ..types import Header
+from ..types.agg_commit import decode_commit_any
 from ..utils import trace
 from ..utils.metrics import MetricsServer, replication_metrics
 
@@ -351,9 +352,9 @@ class Replica:
                 header = Header.decode(bytes.fromhex(frame["hdr"]))
                 vals = (_decode_vals(bytes.fromhex(frame["vals"]))
                         if frame.get("vals") else None)
-                last = (Commit.decode(bytes.fromhex(frame["last"]))
+                last = (decode_commit_any(bytes.fromhex(frame["last"]))
                         if frame.get("last") else None)
-                seen = (Commit.decode(bytes.fromhex(frame["seen"]))
+                seen = (decode_commit_any(bytes.fromhex(frame["seen"]))
                         if frame.get("seen") else None)
                 self.store.put(h, header, last, seen, vals)
                 kind = (frame.get("cert") or {}).get("kind", "none")

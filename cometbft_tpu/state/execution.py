@@ -56,7 +56,16 @@ def median_time(commit: Commit, vals: ValidatorSet) -> Timestamp:
     WeightedMedian): every non-ABSENT signature's timestamp counts
     (including NIL votes), validators are looked up by address, and the
     pick is the first sorted timestamp whose cumulative weight reaches
-    total/2 (ties take the earlier timestamp)."""
+    total/2 (ties take the earlier timestamp).
+
+    A certificate-native commit (CertCommit) carries ONE canonical
+    timestamp all signers covered — the weighted median of N copies of
+    one value is that value, so the answer is exact, not approximate.
+    The branch must be explicit: the synthesized per-slot view has empty
+    addresses, which the by-address walk would silently drop."""
+    cert = getattr(commit, "cert", None)
+    if cert is not None:
+        return cert.timestamp
     fast = _median_time_columnar(commit, vals)
     if fast is not None:
         return fast

@@ -13,6 +13,8 @@ import threading
 
 from ..encoding import proto as pb
 from ..types import Block, Commit
+from ..types.agg_commit import decode_commit_any
+from ..utils.metrics import store_metrics
 from .kv import KVStore
 
 
@@ -40,15 +42,31 @@ def _key_ext_commit(h: int) -> bytes:
     return b"EC:" + h.to_bytes(8, "big")
 
 
+def _key_full_seen_commit(h: int) -> bytes:
+    # full signature column retained beside a certificate-native seen
+    # commit, recent heights only (evidence window; ISSUE 17)
+    return b"SCF:" + h.to_bytes(8, "big")
+
+
 _KEY_STATE = b"BS:state"
 
 
 class BlockStore:
-    def __init__(self, db: KVStore):
+    # Full seen-commit columns are kept only this many recent heights
+    # when the canonical seen commit is certificate-native: evidence for
+    # older heights is already outside the evidence params' max window
+    # in practice, and the certificate remains verifiable forever.
+    DEFAULT_FULL_COMMIT_WINDOW = 64
+
+    def __init__(self, db: KVStore, full_commit_window: int | None = None):
         self._db = db
         self._lock = threading.RLock()
         self._base = 0
         self._height = 0
+        self.full_commit_window = (
+            self.DEFAULT_FULL_COMMIT_WINDOW
+            if full_commit_window is None else full_commit_window
+        )
         raw = db.get(_KEY_STATE)
         if raw:
             d = pb.fields_to_dict(raw)
@@ -71,26 +89,43 @@ class BlockStore:
         payload = pb.f_varint(1, self._base) + pb.f_varint(2, self._height)
         sets.append((_KEY_STATE, payload))
 
-    def save_block(self, block: Block, seen_commit: Commit) -> None:
+    def save_block(self, block: Block, seen_commit: Commit,
+                   full_seen_commit: Commit | None = None) -> None:
         h = block.header.height
         with self._lock:
             if self._height and h != self._height + 1:
                 raise ValueError(
                     f"non-contiguous save: have {self._height}, got {h}"
                 )
+            seen_enc = seen_commit.encode()
             sets = [
                 (_key_block(h), block.encode()),
-                (_key_seen_commit(h), seen_commit.encode()),
+                (_key_seen_commit(h), seen_enc),
                 (_key_block_hash(block.hash()), h.to_bytes(8, "big")),
                 (_key_height_hash(h), block.hash()),
             ]
+            deletes: list[bytes] = []
+            if full_seen_commit is not None:
+                # certificate took the canonical slot: keep the full
+                # column in the recent evidence window only
+                sets.append(
+                    (_key_full_seen_commit(h), full_seen_commit.encode())
+                )
+                if h - self.full_commit_window >= 1:
+                    deletes.append(
+                        _key_full_seen_commit(h - self.full_commit_window)
+                    )
             if block.last_commit is not None and h > 1:
-                sets.append((_key_commit(h - 1), block.last_commit.encode()))
+                canonical = block.last_commit.encode()
+                sets.append((_key_commit(h - 1), canonical))
+                store_metrics().commit_bytes.observe(len(canonical))
+            else:
+                store_metrics().commit_bytes.observe(len(seen_enc))
             self._height = h
             if self._base == 0:
                 self._base = h
             self._save_meta(sets)
-            self._db.write_batch(sets)
+            self._db.write_batch(sets, deletes)
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         """Store a commit without its block — the state-sync bootstrap
@@ -130,13 +165,30 @@ class BlockStore:
         return self.load_block(int.from_bytes(raw, "big"))
 
     def load_block_commit(self, height: int) -> Commit | None:
-        """The canonical commit FOR `height` (stored with block height+1)."""
+        """The canonical commit FOR `height` (stored with block height+1).
+
+        ONE read path for both store generations (ISSUE 17): pre-
+        certificate stores hold plain signature columns, cert-native
+        stores hold CertCommits — decode_commit_any routes on the bytes.
+        """
         raw = self._db.get(_key_commit(height))
-        return Commit.decode(raw, trusted_bytes=True) if raw else None
+        return decode_commit_any(raw, trusted_bytes=True) if raw else None
 
     def load_seen_commit(self, height: int) -> Commit | None:
         raw = self._db.get(_key_seen_commit(height))
-        return Commit.decode(raw, trusted_bytes=True) if raw else None
+        return decode_commit_any(raw, trusted_bytes=True) if raw else None
+
+    def load_seen_commit_full(self, height: int) -> Commit | None:
+        """The full signature column for `height` when still inside the
+        evidence window — falls back to the seen commit itself when that
+        already IS a full column (non-BLS chains, pre-cert stores)."""
+        raw = self._db.get(_key_full_seen_commit(height))
+        if raw:
+            return Commit.decode(raw, trusted_bytes=True)
+        seen = self.load_seen_commit(height)
+        if seen is not None and getattr(seen, "cert", None) is not None:
+            return None  # aggregated away and outside the window
+        return seen
 
     def save_extended_commit(self, ext_commit) -> None:
         """Seen commit WITH vote extensions (reference SaveBlockWithExtendedCommit
@@ -157,6 +209,7 @@ class BlockStore:
                 raise ValueError("block store is empty")
             h = self._height
             deletes = [_key_block(h), _key_seen_commit(h),
+                       _key_full_seen_commit(h),
                        _key_commit(h - 1), _key_height_hash(h)]
             bh = self._db.get(_key_height_hash(h))
             if bh:
@@ -184,8 +237,8 @@ class BlockStore:
                 if bh:
                     deletes.append(_key_block_hash(bh))
                 deletes += [_key_block(h), _key_commit(h),
-                            _key_seen_commit(h), _key_height_hash(h),
-                            _key_ext_commit(h)]
+                            _key_seen_commit(h), _key_full_seen_commit(h),
+                            _key_height_hash(h), _key_ext_commit(h)]
                 pruned += 1
             self._base = retain_height
             sets: list = []

@@ -25,9 +25,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..crypto import merkle
 from ..encoding import proto as pb
 from .basic import BlockID, Timestamp
-from .block import BlockIDFlag, Commit
+from .block import BlockIDFlag, Commit, CommitSig
 from .vote import SignedMsgType, canonical_vote_bytes
 
 ZERO_TIME = Timestamp(0, 0)
@@ -37,6 +38,12 @@ BLS_SIG_SIZE = 96
 
 class AggCommitError(Exception):
     pass
+
+
+class AggCommitPowerError(AggCommitError):
+    """Certificate structurally valid but below the power threshold —
+    distinguished so verdict mapping (cert vs sig-column differential
+    pins) can raise ErrNotEnoughVotingPower, not ErrInvalidSignature."""
 
 
 @dataclass
@@ -136,7 +143,7 @@ class AggregateCommit:
                 tally += v.voting_power
         threshold = vals.total_voting_power() * 2 // 3
         if tally <= threshold:
-            raise AggCommitError(
+            raise AggCommitPowerError(
                 f"certificate power {tally} <= threshold {threshold}")
         if not bls.cert_verify(pubs, self.bitmap,
                                self.sign_bytes(chain_id), self.agg_sig,
@@ -173,3 +180,234 @@ class AggregateCommit:
             bitmap=pb.as_bytes(d.get(5, b"")),
             agg_sig=sig,
         )
+
+
+# ======================================================================
+# Certificate-native commit (ISSUE 17): the certificate AS the commit.
+#
+# PR 12's AggregateCommit folds a finished Commit down after the fact;
+# CertCommit makes the fold the canonical object — blocks embed it as
+# their last_commit, the store persists it, blocksync ships it, and
+# every Commit consumer sees a Commit-shaped view (height / round /
+# block_id / signatures) whose signature column is synthesized lazily
+# from the bitmap. Individual signatures are NOT recoverable from the
+# aggregate, so the synthesized slots carry empty addresses/signatures:
+# consumers that need per-validator identity index the validator set by
+# slot position, exactly like the columnar replay path does.
+# ======================================================================
+class _CertSigList:
+    """Commit-shaped signature view over a certificate bitmap.
+
+    len() is the validator-set size; element i is a COMMIT slot (cert
+    timestamp, empty address/signature) when bit i is set, else ABSENT.
+    Materializes at most once, like block.py's _LazySigList."""
+
+    __slots__ = ("_cert", "_n", "_real")
+
+    def __init__(self, cert: AggregateCommit, n: int):
+        self._cert = cert
+        self._n = n
+        self._real = None
+
+    def _mat(self) -> list:
+        if self._real is None:
+            cert = self._cert
+            ts = cert.timestamp
+            absent = CommitSig.absent()
+            self._real = [
+                CommitSig(BlockIDFlag.COMMIT, b"", ts, b"")
+                if cert.has_signer(i) else absent
+                for i in range(self._n)
+            ]
+        return self._real
+
+    def __len__(self):
+        return self._n
+
+    def __bool__(self):
+        return self._n > 0
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __eq__(self, other):
+        if isinstance(other, _CertSigList):
+            other = other._mat()
+        if isinstance(other, list):
+            return self._mat() == other
+        return NotImplemented
+
+
+class CertCommit:
+    """A Commit whose signature column IS a certificate.
+
+    Encoding shares the Commit field slot so blocks/stores need no
+    format negotiation: fields 1 (height varint), 2 (round varint),
+    3 (block_id) match Commit exactly; the per-slot field 4 column is
+    replaced by 5=timestamp, 6=bitmap, 7=agg_sig, 8=set size. A plain
+    Commit never emits fields >= 5, so decode_commit_any routes on the
+    first tag >= 4 it sees."""
+
+    __slots__ = ("cert", "size_", "_hash_memo", "_enc_memo", "_sigs",
+                 "__dict__")
+
+    def __init__(self, cert: AggregateCommit, size: int):
+        self.cert = cert
+        self.size_ = size
+        self._hash_memo = None
+        self._enc_memo = None
+        self._sigs = None
+
+    # -- Commit-shaped surface -----------------------------------------
+    @property
+    def height(self) -> int:
+        return self.cert.height
+
+    @property
+    def round(self) -> int:
+        return self.cert.round
+
+    @property
+    def block_id(self) -> BlockID:
+        return self.cert.block_id
+
+    @property
+    def signatures(self) -> _CertSigList:
+        if self._sigs is None:
+            self._sigs = _CertSigList(self.cert, self.size_)
+        return self._sigs
+
+    def size(self) -> int:
+        return self.size_
+
+    def signer_count(self) -> int:
+        return self.cert.signer_count()
+
+    def hash(self) -> bytes:
+        # One leaf per certificate (not per slot): the hash commits to
+        # the exact aggregate evidence. Deterministic across encode
+        # memoization — derived from the canonical encoding.
+        if self._hash_memo is None:
+            self._hash_memo = merkle.hash_from_byte_slices([self.encode()])
+        return self._hash_memo
+
+    def verify_columns(self):
+        """No per-slot sig columns exist; callers fall to cert paths."""
+        return None
+
+    def invalidate_memos(self) -> None:
+        self._hash_memo = None
+        self._enc_memo = None
+        self._sigs = None
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, CertCommit)
+            and other.cert == self.cert
+            and other.size_ == self.size_
+        )
+
+    def __repr__(self):
+        return (f"CertCommit(h={self.cert.height} r={self.cert.round} "
+                f"signers={self.cert.signer_count()}/{self.size_})")
+
+    # -- codec ----------------------------------------------------------
+    def encode(self) -> bytes:
+        if self._enc_memo is None:
+            c = self.cert
+            self._enc_memo = (
+                pb.f_varint(1, c.height)
+                + pb.f_varint(2, c.round)
+                + pb.f_embedded(3, c.block_id.encode())
+                + pb.f_embedded(5, c.timestamp.encode())
+                + pb.f_bytes(6, c.bitmap)
+                + pb.f_bytes(7, c.agg_sig)
+                + pb.f_varint(8, self.size_)
+            )
+        return self._enc_memo
+
+    def wire_size(self) -> int:
+        return len(self.encode())
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "CertCommit":
+        d = pb.fields_to_dict(buf)
+        sig = pb.as_bytes(d.get(7, b""))
+        if len(sig) != BLS_SIG_SIZE:
+            raise AggCommitError("bad aggregate signature size")
+        cert = AggregateCommit(
+            height=pb.to_i64(d.get(1, 0)),
+            round=pb.to_i64(d.get(2, 0)),
+            block_id=BlockID.decode(pb.as_bytes(d.get(3, b""))),
+            timestamp=Timestamp.decode(pb.as_bytes(d.get(5, b""))),
+            bitmap=pb.as_bytes(d.get(6, b"")),
+            agg_sig=sig,
+        )
+        size = pb.to_i64(d.get(8, 0))
+        if size < 0 or len(cert.bitmap) != (size + 7) // 8:
+            raise AggCommitError(
+                f"bitmap size {len(cert.bitmap)} inconsistent with "
+                f"declared set size {size}")
+        return cls(cert, size)
+
+    @classmethod
+    def from_commit(cls, commit: Commit) -> "CertCommit":
+        """Fold a uniform-timestamp all-BLS Commit (AggCommitError when
+        it cannot fold — caller keeps the full column)."""
+        return cls(AggregateCommit.from_commit(commit), commit.size())
+
+    # -- verification ----------------------------------------------------
+    def verify(self, chain_id: str, vals, nchunks: int = 0) -> None:
+        if self.size_ != len(vals):
+            raise AggCommitError(
+                f"commit size {self.size_} != validator set {len(vals)}")
+        self.cert.verify(chain_id, vals, nchunks=nchunks)
+
+
+def decode_commit_any(buf: bytes, trusted_bytes: bool = False):
+    """One decode path for both commit formats (the blockstore-migration
+    seam): plain sig-column Commits and certificate-native CertCommits
+    share field slots 1–3, so a cheap top-level tag scan picks the
+    decoder — field 4 (per-slot column) => Commit, fields 5–8 =>
+    CertCommit, neither (genesis empty commit) => Commit."""
+    rv = pb.read_uvarint
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = rv(buf, i)
+        f, wt = tag >> 3, tag & 7
+        if f >= 4:
+            if f == 4:
+                return Commit.decode(buf, trusted_bytes=trusted_bytes)
+            return CertCommit.decode(buf)
+        if wt == 0:
+            _, i = rv(buf, i)
+        elif wt == 2:
+            ln, i = rv(buf, i)
+            i += ln
+        elif wt == 1:
+            i += 8
+        elif wt == 5:
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} in commit")
+    return Commit.decode(buf, trusted_bytes=trusted_bytes)
+
+
+def fold_commit(commit, vals=None):
+    """Certificate-native fold seam: return a CertCommit when `commit`
+    can fold (already cert; or uniform-timestamp all-BLS column), else
+    the commit unchanged. Mixed/ed25519 sets and non-uniform timestamps
+    fall back silently — byte-identical to pre-certificate behavior."""
+    if isinstance(commit, CertCommit):
+        return commit
+    if not isinstance(commit, Commit) or not commit.signatures:
+        return commit
+    if vals is not None and not getattr(vals, "all_bls", lambda: False)():
+        return commit
+    try:
+        return CertCommit.from_commit(commit)
+    except AggCommitError:
+        return commit

@@ -673,6 +673,7 @@ class Block:
         for BlockID/part-set work then reuses them. Wire-received bytes
         must never be trusted here (a non-canonical adversarial encoding
         would define this node's BlockID)."""
+        from .agg_commit import decode_commit_any
         from .evidence import decode_evidence
 
         d = pb.fields_to_dict(buf)
@@ -685,7 +686,9 @@ class Block:
             data=Data.decode(pb.as_bytes(d.get(2, b""))),
             evidence=evidence,
             last_commit=(
-                Commit.decode(pb.as_bytes(d.get(4, b"")), trusted_bytes=trusted_bytes)
+                decode_commit_any(
+                    pb.as_bytes(d.get(4, b"")), trusted_bytes=trusted_bytes
+                )
                 if 4 in d
                 else Commit()
             ),

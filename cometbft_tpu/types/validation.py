@@ -215,6 +215,138 @@ def _check_commit_basics(vals: ValidatorSet, commit: Commit, height: int, block_
         raise ErrInvalidBlockID("commit is for a different block")
 
 
+# ----------------------------------------------------------------------
+# certificate-native verification (ISSUE 17): a CertCommit is ONE
+# pairing check regardless of signer count, routed through the shared
+# VerifyScheduler when a verify_context is active (non-coalescable: the
+# scheduler dispatches it individually inside the same drain cycle).
+# ----------------------------------------------------------------------
+class CertCommitVerifier:
+    """Scheduler-compatible verifier wrapping one certificate check.
+
+    Duck-types the BatchVerifier surface the scheduler consumes
+    (count()/verify()); coalescable=False keeps it out of the ed25519
+    mega-batch. The AggCommitError that failed verification is kept on
+    .error so callers can raise the precise CommitError subclass."""
+
+    coalescable = False
+
+    def __init__(self, chain_id: str, vals: ValidatorSet, cert_commit):
+        self.chain_id = chain_id
+        self.vals = vals
+        self.cc = cert_commit
+        self.error = None
+
+    def count(self) -> int:
+        return max(1, self.cc.signer_count())
+
+    def verify(self):
+        try:
+            self.cc.verify(self.chain_id, self.vals)
+            return True, [True]
+        except Exception as e:  # AggCommitError
+            self.error = e
+            return False, [False]
+
+    def submit(self):
+        """Pending-compatible inline handle (no-scheduler path)."""
+        outer = self
+
+        class _P:
+            def prefetch(self):
+                pass
+
+            def result(self):
+                return outer.verify()
+
+        return _P()
+
+
+def _raise_cert_error(err) -> None:
+    from .agg_commit import AggCommitPowerError
+
+    if isinstance(err, AggCommitPowerError):
+        raise ErrNotEnoughVotingPower(str(err))
+    raise ErrInvalidSignature(str(err))
+
+
+def _verify_cert_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit,
+    backend: str = "tpu",
+) -> None:
+    """Shared core for verify_commit/verify_commit_light on a
+    CertCommit: structural checks, then one pairing (scheduler-routed
+    when a verify_context is active)."""
+    from ..crypto import bls as _bls
+    from ..crypto.sched import current_context
+
+    _check_commit_basics(vals, commit, height, block_id)
+    if len(vals) != commit.size():
+        raise ErrInvalidCommitSize(
+            f"validator set size {len(vals)} != commit size {commit.size()}"
+        )
+    bv = CertCommitVerifier(chain_id, vals, commit)
+    ctx = current_context()
+    t0 = _time.perf_counter()
+    pc0 = _bls.pairing_checks()
+    if ctx is not None:
+        ok, _bits = ctx.submit(bv).result()
+    else:
+        ok, _bits = bv.verify()
+    dt = _time.perf_counter() - t0
+    if _trace.enabled:
+        _trace.emit("crypto.bls_aggregate", "span",
+                    dur_ms=round(dt * 1e3, 3), n=commit.signer_count(),
+                    pairing_checks=_bls.pairing_checks() - pc0)
+    _observe_partition(_BLS_TAG, "aggregate", commit.signer_count(), dt)
+    if not ok:
+        _raise_cert_error(bv.error)
+
+
+def verify_cert_trusting(
+    chain_id: str,
+    trusted_vals: ValidatorSet,
+    signing_vals: ValidatorSet,
+    commit,
+    trust_level: tuple[int, int] = (1, 3),
+    backend: str = "tpu",
+) -> None:
+    """Certificate analogue of verify_commit_light_trusting for light
+    skipping sync: the bitmap indexes `signing_vals` (the untrusted
+    header's set); signers that are ALSO members of `trusted_vals` must
+    carry more than trust_level of the trusted power. The aggregate
+    itself is then checked with ONE pairing against signing_vals."""
+    num, den = trust_level
+    if den <= 0 or num < 0 or num > den:
+        raise ValueError("invalid trust level")
+    cert = commit.cert
+    n = len(signing_vals)
+    if commit.size() != n or len(cert.bitmap) != (n + 7) // 8:
+        raise ErrInvalidCommitSize(
+            f"certificate size {commit.size()} != signing set {n}")
+    threshold = trusted_vals.total_voting_power() * num // den
+    seen: set[bytes] = set()
+    tally = 0
+    for i in range(n):
+        if not cert.has_signer(i):
+            continue
+        sv = signing_vals.get_by_index(i)
+        _, tv = trusted_vals.get_by_address(sv.address)
+        if tv is None or tv.address in seen:
+            continue
+        seen.add(tv.address)
+        tally += tv.voting_power
+    if tally <= threshold:
+        raise ErrNotEnoughVotingPower(
+            f"trusted tally {tally} <= threshold {threshold}")
+    _verify_cert_commit(chain_id, signing_vals, cert.block_id,
+                        cert.height, commit, backend=backend)
+
+
 def verify_commit(
     chain_id: str,
     vals: ValidatorSet,
@@ -225,6 +357,11 @@ def verify_commit(
 ) -> None:
     """Full verification: every non-absent signature checked
     (reference types/validation.go:21-53)."""
+    from .agg_commit import CertCommit
+
+    if isinstance(commit, CertCommit):
+        return _verify_cert_commit(
+            chain_id, vals, block_id, height, commit, backend=backend)
     _check_commit_basics(vals, commit, height, block_id)
     if len(vals) != commit.size():
         raise ErrInvalidCommitSize(
@@ -261,6 +398,11 @@ def verify_commit_light(
 ) -> None:
     """Verify only COMMIT votes; succeed on +2/3
     (reference types/validation.go:61; AllSignatures variant :136)."""
+    from .agg_commit import CertCommit
+
+    if isinstance(commit, CertCommit):
+        return _verify_cert_commit(
+            chain_id, vals, block_id, height, commit, backend=backend)
     _check_commit_basics(vals, commit, height, block_id)
     if len(vals) != commit.size():
         raise ErrInvalidCommitSize(

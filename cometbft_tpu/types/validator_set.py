@@ -202,6 +202,19 @@ class ValidatorSet:
         self.__dict__["_ed_cols"] = cols
         return cols
 
+    def all_bls(self) -> bool:
+        """True when every validator key is BLS12-381 — the gate for
+        certificate-native folding. Memoized like ed25519_columns:
+        consensus consults it once per commit on a frozen set."""
+        memo = self.__dict__.get("_all_bls")
+        if memo is None:
+            memo = bool(self.validators) and all(
+                v.pub_key.type_tag() == "tendermint/PubKeyBls12_381"
+                for v in self.validators
+            )
+            self.__dict__["_all_bls"] = memo
+        return memo
+
     def freeze(self) -> "ValidatorSet":
         """Seal the set against mutation. State snapshots share (alias)
         ValidatorSet objects instead of defensively copying; the safety

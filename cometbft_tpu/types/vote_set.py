@@ -110,6 +110,10 @@ class VoteSet:
         self.maj23: BlockID | None = None
         self.votes_by_block: dict[bytes, _BlockVotes] = {}
         self.peer_maj23s: dict[str, BlockID] = {}
+        # certificate-native (ISSUE 17): a verified AggregateCommit
+        # applied to this set (apply_certificate). Proves +2/3 without
+        # per-validator votes; make_commit then yields a CertCommit.
+        self.cert = None
 
     # ------------------------------------------------------------------
     def size(self) -> int:
@@ -242,10 +246,56 @@ class VoteSet:
         return self.sum == self.val_set.total_voting_power()
 
     # ------------------------------------------------------------------
+    def apply_certificate(self, cert) -> bool:
+        """Install a VERIFIED aggregate-precommit certificate as this
+        set's +2/3 evidence (certificate-native catchup gossip).
+
+        The caller has already run cert.verify() (one pairing) against
+        this set's validators — only structural consistency is
+        re-checked here. No phantom per-validator votes are synthesized
+        and votes_bit_array is untouched: vote gossip must never offer
+        signatures this node cannot serve. Returns True when the
+        certificate newly established the majority."""
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise ValueError("certificates apply to precommit sets only")
+        if cert.height != self.height or cert.round != self.round:
+            raise ErrVoteUnexpectedStep(
+                f"certificate for {cert.height}/{cert.round}, set is "
+                f"{self.height}/{self.round}")
+        n = self.size()
+        if len(cert.bitmap) != (n + 7) // 8:
+            raise ValueError(
+                f"certificate bitmap does not match set size {n}")
+        tally = sum(
+            self.val_set.get_by_index(i).voting_power
+            for i in range(n) if cert.has_signer(i)
+        )
+        if tally <= self.val_set.total_voting_power() * 2 // 3:
+            raise ValueError("certificate power below +2/3")
+        newly = self.maj23 is None
+        self.cert = cert
+        if self.maj23 is None:
+            self.maj23 = cert.block_id
+        return newly
+
     def make_commit(self) -> Commit:
-        """+2/3 precommit set -> Commit (reference MakeCommit)."""
+        """+2/3 precommit set -> Commit (reference MakeCommit). A set
+        whose majority came from an applied certificate yields the
+        certificate-native CertCommit instead of a signature column —
+        the aggregate cannot be split back into per-validator slots."""
         if self.signed_msg_type != SignedMsgType.PRECOMMIT:
             raise ValueError("cannot MakeCommit() unless VoteSet.Type is PRECOMMIT")
+        if self.cert is not None:
+            # prefer the full column when this node ALSO collected +2/3
+            # real votes (richer evidence); the certificate carries the
+            # majority only when the votes alone do not
+            bv = (self.votes_by_block.get(_block_key(self.maj23))
+                  if self.maj23 is not None else None)
+            quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+            if bv is None or bv.sum < quorum:
+                from .agg_commit import CertCommit
+
+                return CertCommit(self.cert, self.size())
         if self.maj23 is None or self.maj23.is_zero():
             raise ValueError("cannot MakeCommit() unless +2/3 for a block")
         sigs = []

@@ -315,6 +315,13 @@ class ConsensusMetrics:
             "consensus", "speculation_total",
             "Speculative proposal assemblies by outcome",
             labels=("outcome",))
+        # certificate-native consensus (ISSUE 17): one AggregateCommit
+        # frame replaces N precommit frames for catchup gossip
+        self.cert_gossip_total = reg.counter(
+            "consensus", "cert_gossip_total",
+            "Aggregate-precommit certificates received via gossip, by "
+            "outcome (applied/dup/redundant/stale/invalid/non_bls/"
+            "disabled)", labels=("outcome",))
 
 
 class MempoolMetrics:
@@ -399,6 +406,20 @@ class StateMetrics:
             "Commit signature verification wall time (TPU kernel path)")
 
 
+class StoreMetrics:
+    # commit bytes span ~100 B certificates to multi-MB signature
+    # columns at 10k validators
+    COMMIT_BUCKETS = (128, 512, 2048, 8192, 32768, 131072, 524288, 2097152)
+
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.commit_bytes = reg.histogram(
+            "store", "commit_bytes",
+            "Encoded canonical-commit bytes written per block "
+            "(certificate-native BLS heights shrink this ~N/1)",
+            buckets=StoreMetrics.COMMIT_BUCKETS)
+
+
 class BlockSyncMetrics:
     def __init__(self, reg: Registry | None = None):
         reg = reg or DEFAULT_REGISTRY
@@ -421,6 +442,10 @@ class BlockSyncMetrics:
         self.bad_blocks_total = reg.counter(
             "blocksync", "bad_blocks_total",
             "Blocks that failed verification (request redone)")
+        self.cert_verify_seconds = reg.histogram(
+            "blocksync", "cert_verify_seconds",
+            "Certificate (one-pairing) commit verification wall time "
+            "during replay, per commit", buckets=TX_STAGE_BUCKETS)
 
 
 class StateSyncMetrics:
@@ -589,6 +614,10 @@ def state_metrics() -> StateMetrics:
 
 def blocksync_metrics() -> BlockSyncMetrics:
     return _bundle("blocksync", BlockSyncMetrics)
+
+
+def store_metrics() -> StoreMetrics:
+    return _bundle("store", StoreMetrics)
 
 
 def statesync_metrics() -> StateSyncMetrics:

@@ -264,6 +264,7 @@ SPAN_REGISTRY = {
     "consensus.step": "span closing the consensus step being left (height/round/dur_ms/next)",
     "consensus.finalize_commit": "block decided at height/round, with tx count",
     "consensus.propose_speculative": "one speculative proposal assembly overlapping the previous height's commit gap (height/txs/bytes)",
+    "consensus.cert_aggregate": "one aggregate-precommit certificate verified from catchup gossip (height/round/signers/outcome/dur_ms)",
     "state.apply_block": "ApplyBlock with validate/finalize/commit/save stage breakdown",
     "blocksync.block": "one fast-synced block: fetch→verify→apply breakdown",
     "crypto.batch_verify": "one batch-verify dispatch: path, n, modeled host/wire/device terms",

@@ -591,3 +591,21 @@ def test_bench_compare_replicated_advisory_never_gates():
     assert p.returncode == 0, p.stderr
     assert "city replicated" in p.stdout
     assert "bench_compare:" in p.stdout
+
+
+def test_bench_compare_certnative_advisory_never_gates():
+    """tools/bench_compare.py --certnative --advisory: the certificate-
+    native diff (cert-vs-column verdict pins and the one-pairing-per-
+    block replay invariant first-class) is informational in tier-1 —
+    rc 0 whether the certnative record exists on both sides, one side,
+    or regressed — and the certnative line always renders."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_compare.py"),
+         "--certnative", "--advisory", "--threshold", "0.001"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    assert "certnative" in p.stdout
+    assert "bench_compare:" in p.stdout

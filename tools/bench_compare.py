@@ -592,6 +592,116 @@ def compare_replicated(ref: str, threshold: float,
     }
 
 
+def _certnative_record(flat_src: str):
+    """The certnative record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        rec = data.get("certnative")
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+# the cert-side byte footprints have no recognized lower-better suffix
+# ("bytes" alone is polarity-free: sink_bytes is cost, bytes_ratio is
+# win), and the feed saving percentage is higher-better; the ratios and
+# sigs_per_sec/speedup keys the heuristics already read correctly
+_CERT_DIRECTIONS = {
+    "wire.cert_commit_bytes": "lower",
+    "store.cert_bytes_per_block": "lower",
+    "feed.cert_frame_bytes": "lower",
+    "feed.saving_pct": "higher",
+}
+# non-measurement leaves: run geometry, gate metadata, the column-side
+# constants (baseline-format properties, not this feature's output),
+# 1-core wall-clock samples, and the invariants handled first-class
+_CERT_SKIP = ("gate.", "verdicts.", "validators", "blocks",
+              "replay.pairing_checks", "replay.column_s", "replay.cert_s")
+
+
+def compare_certnative(ref: str, threshold: float,
+                       relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the certificate-native workload (ISSUE 17): wire/store/
+    feed byte footprints and the one-pairing replay throughput go
+    through the directional machinery; two invariants are first-class
+    and zero-tolerance — the cert-vs-column verdict differential must
+    show ZERO mismatches (a certificate accepting what the signature
+    column rejects is a soundness hole, not a perf regression), and
+    replay must stay at one pairing per block."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _certnative_record(f.read())
+    base = _certnative_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no certnative record on one side"}
+
+    b_flat, c_flat = _flatten(base), _flatten(cur)
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p in key for p in _CERT_SKIP):
+            continue
+        d = _CERT_DIRECTIONS.get(key) or direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    mism = {"key": "verdicts.mismatches",
+            "baseline": b_flat.get("verdicts.mismatches", 0.0),
+            "current": c_flat.get("verdicts.mismatches", 0.0),
+            "worse": c_flat.get("verdicts.mismatches", 0.0) > 0}
+    pair = {"key": "replay.pairings_per_block",
+            "baseline": (b_flat.get("replay.pairing_checks", 0.0)
+                         / max(b_flat.get("blocks", 1.0), 1.0)),
+            "current": (c_flat.get("replay.pairing_checks", 0.0)
+                        / max(c_flat.get("blocks", 1.0), 1.0)),
+            "worse": (c_flat.get("replay.pairing_checks", 0.0)
+                      > c_flat.get("blocks", 0.0))}
+    invariants = [mism, pair]
+    regs = [r for r in rows if r["worse"]]
+    regs += [i for i in invariants if i["worse"]]
+    return {
+        "file": relpath, "mode": "certnative",
+        "invariants": invariants,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_certnative(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"certnative: skipped ({rep['skipped']})")
+        return
+    broken = [i["key"] for i in rep["invariants"] if i["worse"]]
+    tag = "REGRESSION" if broken else "          "
+    print(f"certnative ({rep['file']}): {tag} verdict-pin/one-pairing "
+          f"invariants {'BROKEN: ' + ', '.join(broken) if broken else 'held'}")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-32s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_replicated(rep: dict) -> None:
     if "skipped" in rep:
         print(f"city replicated: skipped ({rep['skipped']})")
@@ -706,6 +816,10 @@ def main(argv=None) -> int:
                     help="also diff the scale-out serving-plane workload "
                          "(zero-gap and byte-identity invariants "
                          "first-class)")
+    ap.add_argument("--certnative", action="store_true",
+                    help="also diff the certificate-native workload "
+                         "(cert-vs-column verdict pins and the one-"
+                         "pairing-per-block replay invariant first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -729,8 +843,11 @@ def main(argv=None) -> int:
                 if args.city else None)
     repl_rep = (compare_replicated(args.ref, args.threshold)
                 if args.replicas else None)
+    cert_rep = (compare_certnative(args.ref, args.threshold)
+                if args.certnative else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    for extra in (ingest_rep, bls_rep, das_rep, city_rep, repl_rep):
+    for extra in (ingest_rep, bls_rep, das_rep, city_rep, repl_rep,
+                  cert_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -746,6 +863,8 @@ def main(argv=None) -> int:
         summary["city_combined"] = city_rep
     if repl_rep is not None:
         summary["city_replicated"] = repl_rep
+    if cert_rep is not None:
+        summary["certnative"] = cert_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -775,6 +894,8 @@ def main(argv=None) -> int:
             _print_city(city_rep)
         if repl_rep is not None:
             _print_replicated(repl_rep)
+        if cert_rep is not None:
+            _print_certnative(cert_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "
