@@ -523,6 +523,85 @@ def cmd_replica(args) -> int:
     return 0
 
 
+def _parse_named(spec: str, prefix: str) -> dict[str, str]:
+    """Parse "name=value,name=value" (bare values get prefix0..N)."""
+    out: dict[str, str] = {}
+    for i, part in enumerate(p for p in spec.split(",") if p.strip()):
+        part = part.strip()
+        if "=" in part:
+            name, _, value = part.partition("=")
+            out[name.strip()] = value.strip()
+        else:
+            out[f"{prefix}{i}"] = part
+    return out
+
+
+def cmd_watchtower(args) -> int:
+    """Streaming safety auditor (watchtower/, ROADMAP #5): tail N core
+    nodes' replication feeds + optional trace sinks, continuously check
+    forks / equivocation / certificates / data availability / live
+    stalls, and emit structured verdicts. Shaped like a replica
+    process-wise — prints one JSON discovery line, serves /metrics +
+    /healthz, exits on SIGTERM — but holds no serving state at all."""
+    from .utils.metrics import MetricsServer
+    from .watchtower import Watchtower
+
+    wt_cfg = None
+    cfg_file = _cfg_paths(args.home)["config_file"]
+    if os.path.exists(cfg_file):
+        from .config import Config
+
+        wt_cfg = Config.load(cfg_file).watchtower
+    nodes_spec = args.nodes or (wt_cfg.node_urls if wt_cfg else "")
+    if not nodes_spec:
+        print("watchtower: --nodes (or [watchtower] node_urls) required",
+              file=sys.stderr)
+        return 1
+    nodes = _parse_named(nodes_spec, "node")
+    sinks = _parse_named(
+        args.trace_sinks or (wt_cfg.trace_sinks if wt_cfg else ""), "node")
+    wt = Watchtower(
+        nodes,
+        trace_sinks=sinks,
+        full_commit_window=(wt_cfg.full_commit_window if wt_cfg else 16),
+        da_interval_s=(wt_cfg.da_interval_s if wt_cfg else 2.0),
+        da_samples=(wt_cfg.da_samples if wt_cfg else 4),
+        da_alarm_after=(wt_cfg.da_alarm_after if wt_cfg else 2),
+        stall_interval_s=(wt_cfg.stall_interval_s if wt_cfg else 1.0),
+        verdict_path=(args.verdict_path
+                      or (wt_cfg.verdict_path if wt_cfg else "")),
+    )
+    wt.start()
+    mhost, _, mport = args.metrics_laddr.rpartition(":")
+    srv = MetricsServer(
+        host=mhost or "127.0.0.1", port=int(mport or 0),
+        height_fn=lambda: max(
+            (n["audited"] for n in wt.status()["nodes"].values()),
+            default=0),
+        ready_fn=wt.ready,
+    )
+    srv.start()
+    print(json.dumps({
+        "watchtower": True,
+        "nodes": nodes,
+        "metrics": list(srv.addr),
+        "verdict_path": wt.verdict_path or None,
+    }), flush=True)
+    import signal as _signal
+
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _term)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+        wt.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -608,6 +687,18 @@ def main(argv=None) -> int:
     sp.add_argument("--no-forward", action="store_true",
                     help="disable broadcast_tx_* admission forwarding")
     sp.set_defaults(fn=cmd_replica)
+    sp = sub.add_parser("watchtower")
+    sp.add_argument("--nodes", default="",
+                    help="comma-separated name=http://host:port feeds to "
+                         "audit (default: [watchtower] node_urls)")
+    sp.add_argument("--trace-sinks", default="",
+                    help="comma-separated name=/path/to/trace.jsonl for "
+                         "the live stall classifier")
+    sp.add_argument("--metrics-laddr", default="127.0.0.1:0",
+                    help="metrics/healthz listen address")
+    sp.add_argument("--verdict-path", default="",
+                    help="append verdicts as JSONL here as well")
+    sp.set_defaults(fn=cmd_watchtower)
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
     args = ap.parse_args(argv)

@@ -361,6 +361,49 @@ class ReplicationConfig:
 
 
 @dataclass
+class WatchtowerConfig:
+    """Streaming safety auditor (watchtower/, ROADMAP #5).
+
+    Read by `cli.py watchtower`, never by a node: the auditor is a
+    stateless external process that tails N core nodes' replication
+    feeds (plus optional trace sinks) and runs the safety/liveness
+    checks online. Core nodes only need `[replication] serve = true`.
+    """
+
+    # comma-separated core RPC base URLs (http://host:port) to audit
+    node_urls: str = ""
+    # comma-separated trace-sink paths for the online stall classifier
+    # and the equivocation feed; empty disables trace-driven checks
+    trace_sinks: str = ""
+    # re-derive CertCommits against the retained column inside this
+    # window of the tip (mirrors the store's full_commit_window)
+    full_commit_window: int = 16
+    # DA withholding watchdog cadence and per-sweep sample count
+    da_interval_s: float = 2.0
+    da_samples: int = 4
+    # consecutive failed/stalled DA sweeps before the alarm raises
+    da_alarm_after: int = 2
+    # online stall classifier poll cadence
+    stall_interval_s: float = 1.0
+    # structured JSONL verdict log ("" = trace sink only)
+    verdict_path: str = ""
+
+    def validate(self) -> None:
+        if self.full_commit_window < 0:
+            raise ValueError(
+                "watchtower.full_commit_window must be >= 0")
+        if self.da_interval_s <= 0:
+            raise ValueError("watchtower.da_interval_s must be positive")
+        if self.da_samples < 1:
+            raise ValueError("watchtower.da_samples must be >= 1")
+        if self.da_alarm_after < 1:
+            raise ValueError("watchtower.da_alarm_after must be >= 1")
+        if self.stall_interval_s <= 0:
+            raise ValueError(
+                "watchtower.stall_interval_s must be positive")
+
+
+@dataclass
 class SchedConfig:
     """Shared verification scheduler (crypto/sched.py, ROADMAP #4).
 
@@ -443,6 +486,8 @@ class Config:
     da: DAConfig = field(default_factory=DAConfig)
     replication: ReplicationConfig = field(
         default_factory=ReplicationConfig)
+    watchtower: WatchtowerConfig = field(
+        default_factory=WatchtowerConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -452,7 +497,7 @@ class Config:
         for section in (self.base, self.rpc, self.p2p, self.mempool,
                         self.consensus, self.blocksync, self.statesync,
                         self.storage, self.light, self.da, self.replication,
-                        self.sched, self.instrumentation):
+                        self.watchtower, self.sched, self.instrumentation):
             section.validate()
 
     # -- paths ----------------------------------------------------------
@@ -495,6 +540,7 @@ class Config:
             emit("light", self.light),
             emit("da", self.da),
             emit("replication", self.replication),
+            emit("watchtower", self.watchtower),
             emit("sched", self.sched),
             emit("instrumentation", self.instrumentation),
         ]
@@ -536,6 +582,7 @@ class Config:
             light=mk(LightConfig, d.get("light", {})),
             da=mk(DAConfig, d.get("da", {})),
             replication=mk(ReplicationConfig, d.get("replication", {})),
+            watchtower=mk(WatchtowerConfig, d.get("watchtower", {})),
             sched=mk(SchedConfig, d.get("sched", {})),
             instrumentation=mk(InstrumentationConfig,
                                d.get("instrumentation", {})),

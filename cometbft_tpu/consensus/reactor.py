@@ -608,7 +608,19 @@ class ConsensusReactor(Reactor):
             # proposal itself is picked up from cs.proposal by gossip;
             # nothing to store (cs sets cs.proposal before broadcasting)
             pass
-        # VoteMessage: served from cs.votes by the vote gossip
+        elif isinstance(msg, VoteMessage) and msg.direct:
+            # a vote deliberately absent from our own vote set (the
+            # byzantine equivocation shadow) — gossip pull would never
+            # pick it up, so push it to every peer once
+            raw = encode_consensus_msg(msg)
+            with self._lock:
+                peers = [ps.peer for ps in self._peers.values()]
+            for peer in peers:
+                try:
+                    peer.send(VOTE_CHANNEL, raw)
+                except Exception:  # noqa: BLE001 — peer mid-disconnect
+                    pass
+        # other VoteMessage: served from cs.votes by the vote gossip
 
     # -- inbound --------------------------------------------------------
     def receive(self, chan_id: int, peer, raw: bytes) -> None:

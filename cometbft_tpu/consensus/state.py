@@ -103,6 +103,12 @@ class ProposalMessage:
 @dataclass
 class VoteMessage:
     vote: Vote
+    # votes normally propagate by gossip pull from the vote sets; a
+    # vote that is deliberately NOT in our own set (the byzantine
+    # shadow from privval/byzantine.py) must be pushed on the wire
+    # explicitly or it never leaves the process. Local-only flag —
+    # the codec encodes just the vote.
+    direct: bool = False
 
 
 @dataclass
@@ -475,6 +481,7 @@ class ConsensusState:
                 self.last_commit.add_vote(v)
             except ErrVoteConflictingVotes as e:
                 self.evidence.append(e)
+                self._trace_conflicting_votes(e)
             except Exception:
                 pass
             return
@@ -494,6 +501,7 @@ class ConsensusState:
             added = self.votes.add_vote(v, peer_id)
         except ErrVoteConflictingVotes as e:
             self.evidence.append(e)
+            self._trace_conflicting_votes(e)
             pool = getattr(self.executor, "evidence_pool", None)
             if pool is not None:  # reference evidencePool.ReportConflictingVotes
                 pool.report_conflicting_votes(e.vote_a, e.vote_b)
@@ -1189,7 +1197,35 @@ class ConsensusState:
         self.privval.sign_vote(self.chain_id, vote, sign_extension=extend)
         if not self._replay_mode:
             self.broadcast(VoteMessage(vote))
+            # byzantine injection seam (privval/byzantine.py): a
+            # double-signing privval hands back a second, conflicting
+            # signed vote for the same HRS. It goes to PEERS ONLY —
+            # never into our own vote set — so the equivocation is
+            # observable on the wire exactly like a remote adversary's.
+            equivocate = getattr(self.privval, "equivocate", None)
+            if equivocate is not None:
+                shadow = equivocate(self.chain_id, vote)
+                if shadow is not None:
+                    self.broadcast(VoteMessage(shadow, direct=True))
         self.send(VoteMessage(vote), "")
+
+    def _trace_conflicting_votes(self, e) -> None:
+        """Surface an equivocation pair on the trace sink: p2p vote
+        records carry no signatures, so this is the only place the
+        watchtower can recover both SIGNED votes to build
+        DuplicateVoteEvidence from."""
+        if not trace.enabled:
+            return
+        try:
+            a, b = e.vote_a, e.vote_b
+            trace.event(
+                "consensus.conflicting_vote",
+                height=a.height, round=a.round, type=int(a.type),
+                val=a.validator_address.hex(),
+                vote_a=a.encode().hex(), vote_b=b.encode().hex(),
+            )
+        except Exception:  # noqa: BLE001 — tracing must not stall consensus
+            pass
 
     # ==================================================================
     # WAL crash recovery

@@ -76,6 +76,17 @@ class Manifest:
     # 17); the runner then re-derives every stored certificate against
     # the validator set as an extra invariant
     key_type: str = "ed25519"
+    # attach a streaming safety auditor to the world: every node serves
+    # its replication feed, an in-process Watchtower tails all of them
+    # (plus the trace sinks), and the run FAILS on any safety verdict —
+    # fork, equivocation, or certificate mismatch (watchtower/, ISSUE 18)
+    watchtower: bool = False
+    # byzantine fault schedule: {"node": ..., "vote_type": "prevote"|
+    # "precommit"|"any", "from_height": N, "to_height": N} entries; the
+    # named node's privval is wrapped to double-sign inside the window
+    # (privval/byzantine.py). Only meaningful with a watchtower (or a
+    # test inspecting evidence) — the net itself tolerates < 1/3.
+    byzantine: list = field(default_factory=list)
 
     @classmethod
     def parse(cls, d: dict) -> "Manifest":
@@ -95,6 +106,8 @@ class Manifest:
             ),
             da_enabled=bool(d.get("da_enabled", False)),
             key_type=d.get("key_type", "ed25519"),
+            watchtower=bool(d.get("watchtower", False)),
+            byzantine=list(d.get("byzantine", [])),
         )
 
 

@@ -140,6 +140,9 @@ class Runner:
         self._load_stop = threading.Event()
         self._load_thread: threading.Thread | None = None
         self.txs_sent = 0
+        # in-process streaming auditor, attached over the nodes' feeds
+        # when manifest.watchtower is set (watchtower/auditor.py)
+        self.watchtower = None
 
     @staticmethod
     def _free_port_base(count: int) -> int:
@@ -232,12 +235,30 @@ class Runner:
             # merges them into a stall-triage report (trace_report.txt)
             if self.trace:
                 cfg.instrumentation.trace_sink = "data/trace.jsonl"
+            # an audited world needs every node publishing its feed —
+            # the watchtower is a feed consumer like any replica
+            if m.watchtower:
+                cfg.replication.serve = True
             cfg.save(cfg_file)
             port = self.starting_port + 2 * i + 1
             self.nodes[spec.name] = _ProcNode(
                 spec.name, home, port,
                 command=self.node_commands.get(spec.name),
                 metrics_port=mport,
+            )
+        # byzantine fault schedule: the named node's privval is wrapped
+        # to double-sign inside the window (privval/byzantine.py reads
+        # the schedule from the environment at node boot)
+        by_node: dict[str, list[dict]] = {}
+        for entry in m.byzantine:
+            e = dict(entry)
+            name = e.pop("node")
+            by_node.setdefault(name, []).append(e)
+        for name, sched in by_node.items():
+            if name not in self.nodes:
+                raise E2EError(f"byzantine schedule names unknown {name}")
+            self.nodes[name].extra_env["COMETBFT_TPU_BYZANTINE"] = (
+                json.dumps(sched)
             )
 
     def _node_id(self, name: str) -> str:
@@ -459,9 +480,51 @@ class Runner:
                 ) from e
             raise
 
+    def _attach_watchtower(self) -> None:
+        """Tail every (non-seed) node's replication feed + trace sink
+        with an in-process auditor; the run fails on any safety verdict
+        it raises (fork / equivocation / certificate mismatch)."""
+        from ..watchtower import Watchtower
+
+        feeds = {
+            name: f"http://127.0.0.1:{n.rpc_port}"
+            for name, n in self.nodes.items() if not self._spec(name).seed
+        }
+        sinks = {}
+        if self.trace:
+            sinks = {
+                name: os.path.join(n.home, "data", "trace.jsonl")
+                for name, n in self.nodes.items()
+                if not self._spec(name).seed
+            }
+        self.watchtower = Watchtower(
+            feeds,
+            chain_id=self.manifest.chain_id,
+            trace_sinks=sinks,
+            verdict_path=os.path.join(self.workdir, "verdicts.jsonl"),
+        )
+        self.watchtower.start()
+
+    def check_watchtower(self) -> dict:
+        """Post-run audit gate: any safety verdict fails the world."""
+        if self.watchtower is None:
+            return {}
+        safety = self.watchtower.safety_verdicts()
+        if safety:
+            lines = "; ".join(
+                f"[{v['check']}] {v.get('detail', '')}" for v in safety[:5]
+            )
+            raise E2EError(
+                f"watchtower raised {len(safety)} safety verdict(s): "
+                f"{lines}"
+            )
+        return self.watchtower.status()
+
     def _run_inner(self) -> None:
         m = self.manifest
         self.start()
+        if m.watchtower:
+            self._attach_watchtower()
         try:
             # one height-ordered schedule: perturbations + late joins
             pending = sorted(
@@ -487,9 +550,20 @@ class Runner:
             # metrics invariant while the nodes are still live: at least
             # one node exposes every key series with a positive height
             self.check_metrics()
+            if self.watchtower is not None:
+                # give the auditor one last drain of the feeds/sinks
+                # before the nodes go away, then gate on its verdicts
+                deadline_wt = time.monotonic() + 5.0
+                while (time.monotonic() < deadline_wt and any(
+                        st["audited"] < m.target_height for st in
+                        self.watchtower.status()["nodes"].values())):
+                    time.sleep(0.2)
         finally:
+            if self.watchtower is not None:
+                self.watchtower.stop()
             self.stop_all()
         self.check_invariants()
+        self.check_watchtower()
 
     # ----------------------------------------------------- flight recorder
     def trace_paths(self) -> dict[str, str]:

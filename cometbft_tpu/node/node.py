@@ -147,6 +147,13 @@ class Node:
                 FilePV.load(kf, sf) if os.path.exists(kf)
                 else FilePV.generate(kf, sf)
             )
+            # byzantine fault injection (test-only): the e2e runner arms
+            # a node by setting COMETBFT_TPU_BYZANTINE in its subprocess
+            # env; the wrapper double-signs per the schedule
+            if os.environ.get("COMETBFT_TPU_BYZANTINE"):
+                from ..privval.byzantine import maybe_wrap
+
+                self.priv_validator = maybe_wrap(self.priv_validator)
 
         # --- handshake / replay ---------------------------------------
         genesis_state = make_genesis_state(

@@ -1,0 +1,136 @@
+"""Test-only double-signing privval for byzantine fault injection.
+
+`ByzantineValv` wraps a real FilePV and, when armed by a fault
+schedule, hands the consensus state machine a SECOND conflicting
+signed vote for the same (height, round, type) via the `equivocate`
+hook in `_sign_and_send_vote`. The shadow vote is signed with the raw
+private key — deliberately bypassing the FilePV LastSignState, which
+exists precisely to prevent this — and votes for a fabricated block
+id, so any two honest observers holding both votes can build
+`DuplicateVoteEvidence` that verifies against the validator set.
+
+The schedule rides the `COMETBFT_TPU_BYZANTINE` environment variable
+as a JSON list of fault windows:
+
+    [{"vote_type": "precommit", "from_height": 3, "to_height": 6}]
+
+`vote_type` is "prevote", "precommit" or "any"; heights are
+inclusive and 0/absent means unbounded. The e2e runner arms one node
+per manifest `byzantine` entry by injecting the env var into that
+node's subprocess only (e2e/runner.py), and node.py wraps the privval
+at load time when the variable is present. Production configurations
+never set it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from ..types.basic import BlockID, PartSetHeader
+from ..types.vote import SignedMsgType, Vote
+
+ENV_VAR = "COMETBFT_TPU_BYZANTINE"
+
+_TYPE_NAMES = {
+    "prevote": SignedMsgType.PREVOTE,
+    "precommit": SignedMsgType.PRECOMMIT,
+}
+
+
+def parse_schedule(raw: str) -> list[dict]:
+    """Validate + normalize a fault-schedule JSON string."""
+    sched = json.loads(raw)
+    if not isinstance(sched, list):
+        raise ValueError("byzantine schedule must be a JSON list")
+    out = []
+    for w in sched:
+        vt = w.get("vote_type", "any")
+        if vt != "any" and vt not in _TYPE_NAMES:
+            raise ValueError(f"unknown vote_type {vt!r}")
+        out.append({
+            "vote_type": vt,
+            "from_height": int(w.get("from_height", 0)),
+            "to_height": int(w.get("to_height", 0)),
+        })
+    return out
+
+
+class ByzantineValv:
+    """A PrivValidator that equivocates on schedule.
+
+    Delegates every legitimate signing operation to the wrapped
+    FilePV — the node's OWN votes stay protected by the last-sign
+    state, so the process never crashes on its own double-sign guard —
+    and fabricates the conflicting twin only through `equivocate`,
+    which consensus broadcasts to peers without adding locally.
+    """
+
+    def __init__(self, inner, schedule: list[dict]):
+        self._inner = inner
+        self._schedule = schedule
+        self.double_signed = 0
+
+    # -- PrivValidator surface (delegation) -----------------------------
+    def pub_key(self):
+        return self._inner.pub_key()
+
+    def address(self) -> bytes:
+        return self._inner.address()
+
+    def sign_vote(self, chain_id: str, vote, sign_extension: bool = False):
+        return self._inner.sign_vote(chain_id, vote,
+                                     sign_extension=sign_extension)
+
+    def sign_proposal(self, chain_id: str, proposal):
+        return self._inner.sign_proposal(chain_id, proposal)
+
+    # -- the fault -------------------------------------------------------
+    def _armed(self, vote) -> bool:
+        for w in self._schedule:
+            vt = w["vote_type"]
+            if vt != "any" and _TYPE_NAMES[vt] != vote.type:
+                continue
+            if w["from_height"] and vote.height < w["from_height"]:
+                continue
+            if w["to_height"] and vote.height > w["to_height"]:
+                continue
+            return True
+        return False
+
+    def equivocate(self, chain_id: str, vote) -> Vote | None:
+        """Return a conflicting signed twin of `vote`, or None.
+
+        The twin votes for a block id derived from (but different to)
+        the real one, at the same HRS with the same timestamp, signed
+        with the raw key. Nil votes are skipped: a nil/non-nil pair at
+        one HRS is still equivocation, but deriving the conflict from
+        a real block id keeps the fixture deterministic either way.
+        """
+        if vote.is_nil() or not self._armed(vote):
+            return None
+        fake_hash = hashlib.sha256(b"equivocation:" + vote.block_id.hash
+                                   ).digest()
+        shadow = Vote(
+            type=vote.type,
+            height=vote.height,
+            round=vote.round,
+            block_id=BlockID(fake_hash,
+                             PartSetHeader(1, fake_hash)),
+            timestamp=vote.timestamp,
+            validator_address=vote.validator_address,
+            validator_index=vote.validator_index,
+        )
+        shadow.signature = self._inner._priv.sign(
+            shadow.sign_bytes(chain_id))
+        self.double_signed += 1
+        return shadow
+
+
+def maybe_wrap(privval, env: dict | None = None):
+    """Wrap `privval` when the byzantine env var is set (node.py)."""
+    raw = (env if env is not None else os.environ).get(ENV_VAR)
+    if not raw:
+        return privval
+    return ByzantineValv(privval, parse_schedule(raw))

@@ -582,6 +582,36 @@ class ReplicationMetrics:
             "(ok/rejected/error)", labels=("tenant", "outcome"))
 
 
+class WatchtowerMetrics:
+    """Streaming safety auditor bundle (watchtower/auditor.py)."""
+
+    def __init__(self, reg: Registry | None = None):
+        reg = reg or DEFAULT_REGISTRY
+        self.checks_total = reg.counter(
+            "watchtower", "checks_total",
+            "Audit checks run, by check (fork/equivocation/cert/da/"
+            "stall) and outcome (ok/violation/error)",
+            labels=("check", "outcome"))
+        self.alarm = reg.gauge(
+            "watchtower", "alarm",
+            "1 while a check's alarm is raised, 0 once clear "
+            "(safety alarms latch for the life of the auditor)",
+            labels=("check",))
+        self.feed_lag_heights = reg.gauge(
+            "watchtower", "feed_lag_heights",
+            "Audit lag behind each watched node's feed tip, in heights",
+            labels=("node",))
+        self.audit_seconds = reg.histogram(
+            "watchtower", "audit_seconds",
+            "Per-height audit latency (all checks against one frame)",
+            labels=("check",), buckets=TX_STAGE_BUCKETS)
+        self.evidence_submitted_total = reg.counter(
+            "watchtower", "evidence_submitted_total",
+            "DuplicateVoteEvidence submissions back to watched nodes "
+            "over RPC, by outcome (ok/rejected/error)",
+            labels=("outcome",))
+
+
 _BUNDLES: dict[str, object] = {}
 _BUNDLES_LOCK = threading.Lock()
 
@@ -638,6 +668,10 @@ def crypto_metrics() -> CryptoMetrics:
 
 def replication_metrics() -> ReplicationMetrics:
     return _bundle("replication", ReplicationMetrics)
+
+
+def watchtower_metrics() -> WatchtowerMetrics:
+    return _bundle("watchtower", WatchtowerMetrics)
 
 
 def reset_bundles() -> None:

@@ -231,13 +231,19 @@ def tail(n: int = 100) -> list[dict]:
         if _fh is not None:
             _fh.flush()
     with open(p, "rb") as f:
-        f.seek(0, os.SEEK_END)
-        size = f.tell()
         window = 256 * 1024
         while True:
+            # Re-measure every iteration: the sink can be truncated or
+            # rotated under the reader (logrotate, a restarting node
+            # reopening in "w" mode), and seeking against a stale size
+            # would either raise or decode a window that no longer
+            # exists as garbage half-lines.
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
             start = max(0, size - window)
             f.seek(start)
-            lines = f.read().decode("utf-8", "replace").splitlines()
+            data = f.read(size - start)
+            lines = data.decode("utf-8", "replace").splitlines()
             if start > 0 and lines:
                 lines = lines[1:]
             out = []
@@ -249,6 +255,55 @@ def tail(n: int = 100) -> list[dict]:
             if len(out) >= n or start == 0:
                 return out[-n:]
             window *= 4
+
+
+class TailReader:
+    """Incremental follow-mode reader over a JSONL trace sink.
+
+    `poll()` returns the records appended since the last call, holding
+    any trailing partial line in a remainder buffer until its newline
+    lands. Rotation/truncation-safe: when the file's current size drops
+    below the saved offset the writer replaced or truncated the sink,
+    so the reader resets to the beginning of the new file instead of
+    seeking past EOF (the bug tail() had: a stale seek yields garbage).
+    A missing file is not an error — the writer may not have started
+    yet — poll() just returns nothing until it appears.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._rest = b""
+
+    def poll(self, max_bytes: int = 4 << 20) -> list[dict]:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < self._offset:
+                    # truncated or rotated under us: start over on the
+                    # new contents and drop the stale partial line
+                    self._offset = 0
+                    self._rest = b""
+                if size == self._offset:
+                    return []
+                f.seek(self._offset)
+                chunk = f.read(min(size - self._offset, max_bytes))
+        except OSError:
+            return []
+        self._offset += len(chunk)
+        buf = self._rest + chunk
+        lines = buf.split(b"\n")
+        self._rest = lines.pop()  # b"" when chunk ended on a newline
+        out = []
+        for line in lines:
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line.decode("utf-8", "replace")))
+            except ValueError:
+                continue
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -285,6 +340,9 @@ SPAN_REGISTRY = {
     "da.sample_verify": "one sample proof verified against the header's da_root (index/n/ok)",
     "replication.feed_send": "one committed height's frame fanned out on the replication feed (height/subs/bytes)",
     "replication.replica_apply": "one feed frame applied into replica serving state (height/da/dur_ms)",
+    "consensus.conflicting_vote": "conflicting signed votes from one validator at one HRS (height/round/type/vote_a/vote_b hex) — the watchtower's equivocation feed",
+    "watchtower.audit": "one audited feed frame: every check run against a height (node/height/checks/dur_ms)",
+    "watchtower.verdict": "one watchtower finding (check/node/height/safety/detail) — safety verdicts fail an audited e2e run",
 }
 
 

@@ -1,0 +1,27 @@
+"""Streaming safety auditor + byzantine accountability plane.
+
+A watchtower is the external-auditor role the replication feed makes
+cheap: a stateless process tailing N core nodes' feeds (and optional
+trace sinks) that continuously re-checks what the chain claims —
+conflicting commits, equivocation, certificate validity, data
+availability, and live stalls — and emits structured verdicts instead
+of waiting for a post-mortem.
+"""
+
+from .auditor import Watchtower
+from .checks import (
+    build_duplicate_vote_evidence,
+    column_votes,
+    commit_signers,
+    fork_culprits,
+)
+from .stall import OnlineStallClassifier
+
+__all__ = [
+    "Watchtower",
+    "OnlineStallClassifier",
+    "commit_signers",
+    "fork_culprits",
+    "column_votes",
+    "build_duplicate_vote_evidence",
+]

@@ -1,0 +1,203 @@
+"""Online port of traceview's stall classifier (utils/traceview.py
+stall_report): the same triage — slack-scaled advance gap, walk the
+pipeline message classes at the stuck height, name the first class
+with zero receipts and the peers that stayed silent — but fed
+incrementally from streaming trace records instead of a post-mortem
+merge, so the ~1/15 rejoin stall names its node while it is happening.
+
+State per node is bounded: receive counters are kept only for heights
+at or above the node's last committed height (older heights can no
+longer be the stuck one), so a long-running audit does not accumulate
+the whole world's records the way the post-mortem merger does.
+
+Clock handling: records keep their producer timestamps and "now" is
+the maximum timestamp seen across all nodes, so the classifier never
+outruns the sinks it reads (a slow poll loop cannot fabricate a
+stall). Cross-node clock skew below the slack floor (2 s live, 3 s
+advance — both scale up with world span exactly like traceview's) is
+absorbed; the post-mortem path remains the tool for worlds with worse
+clocks.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+PIPELINE_ORDER = ("proposal", "block_part", "prevote", "precommit")
+
+LIVE_SLACK_S = 2.0
+ADVANCE_SLACK_S = 3.0
+
+
+class _NodeState:
+    __slots__ = ("name", "first_t", "last_t", "advance_t", "committed",
+                 "cur_height", "cur_height_t", "round_by_height",
+                 "recv_counts", "precommit_peers", "peers_seen", "records")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.first_t = None
+        self.last_t = None
+        self.advance_t = None
+        self.committed = 0
+        self.cur_height = None
+        self.cur_height_t = None
+        self.round_by_height: dict[int, int] = {}
+        # (height, class) -> receipts; (height, peer) -> precommit votes
+        self.recv_counts: Counter = Counter()
+        self.precommit_peers: Counter = Counter()
+        self.peers_seen: set = set()
+        self.records = 0
+
+    def _prune(self) -> None:
+        floor = self.committed
+        if floor <= 0:
+            return
+        for key in [k for k in self.recv_counts if k[0] < floor]:
+            del self.recv_counts[key]
+        for key in [k for k in self.precommit_peers if k[0] < floor]:
+            del self.precommit_peers[key]
+        for h in [h for h in self.round_by_height if h < floor]:
+            del self.round_by_height[h]
+
+
+class OnlineStallClassifier:
+    """Ingest trace records per node; classify() at any point."""
+
+    def __init__(self, live_slack_s: float = LIVE_SLACK_S,
+                 advance_slack_s: float = ADVANCE_SLACK_S):
+        self.live_slack_floor = live_slack_s
+        self.advance_slack_floor = advance_slack_s
+        self.nodes: dict[str, _NodeState] = {}
+        # p2p node id -> friendly name, learned from the records' own
+        # `node` stamp (every tailed sink names itself), so silent-peer
+        # lists read "node2", not a 40-hex id
+        self.peer_names: dict[str, str] = {}
+        self._t_min = None
+        self._t_max = None
+
+    # -- ingestion -------------------------------------------------------
+    def ingest(self, node: str, rec: dict) -> None:
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            return
+        st = self.nodes.get(node)
+        if st is None:
+            st = self.nodes[node] = _NodeState(node)
+        nid = rec.get("node")
+        if nid and nid not in self.peer_names:
+            self.peer_names[nid] = node
+        st.records += 1
+        if st.first_t is None or ts < st.first_t:
+            st.first_t = ts
+        if st.last_t is None or ts > st.last_t:
+            st.last_t = ts
+        if self._t_min is None or ts < self._t_min:
+            self._t_min = ts
+        if self._t_max is None or ts > self._t_max:
+            self._t_max = ts
+
+        name = rec.get("name")
+        if name in ("consensus.finalize_commit", "blocksync.block"):
+            h = rec.get("height")
+            if isinstance(h, int) and h > st.committed:
+                st.committed = h
+                st.advance_t = ts
+                st._prune()
+        elif name == "consensus.step":
+            h = rec.get("height")
+            if isinstance(h, int):
+                if st.cur_height_t is None or ts >= st.cur_height_t:
+                    st.cur_height = h
+                    st.cur_height_t = ts
+                rd = rec.get("round")
+                if isinstance(rd, int):
+                    prev = st.round_by_height.get(h, 0)
+                    if rd > prev:
+                        st.round_by_height[h] = rd
+        elif name == "p2p.recv":
+            st.peers_seen.add(rec.get("peer"))
+            h = rec.get("height")
+            if isinstance(h, int) and h >= st.committed:
+                msg = rec.get("msg")
+                cls = rec.get("type") if msg == "vote" else msg
+                if cls in PIPELINE_ORDER:
+                    st.recv_counts[(h, cls)] += 1
+                    if cls == "precommit":
+                        st.precommit_peers[(h, rec.get("peer"))] += 1
+
+    # -- classification --------------------------------------------------
+    def classify(self) -> dict:
+        """Same report shape as traceview.stall_report, computed from
+        the incremental state."""
+        if not self.nodes or self._t_max is None:
+            return {"status": "empty", "tip": None, "nodes": {},
+                    "stalled": []}
+        world_start = self._t_min
+        world_end = self._t_max
+        span = max(0.0, world_end - world_start)
+        live_slack = max(self.live_slack_floor, 0.1 * span)
+        advance_slack = max(self.advance_slack_floor, 0.2 * span)
+
+        tip = max(st.committed for st in self.nodes.values())
+        nodes_out: dict[str, dict] = {}
+        stalled = []
+        for st in self.nodes.values():
+            cur_height = st.cur_height
+            if cur_height is None:
+                cur_height = st.committed + 1 if st.committed else None
+            max_round = st.round_by_height.get(cur_height, 0) \
+                if cur_height is not None else 0
+            live = (world_end - st.last_t) <= live_slack
+            gap = world_end - (st.advance_t if st.advance_t is not None
+                               else world_start)
+            info = {
+                "committed": st.committed, "height": cur_height,
+                "max_round": max_round, "live": live,
+                "records": st.records,
+            }
+            nodes_out[st.name] = info
+            lagging = tip - st.committed >= 2
+            churning = max_round >= 2
+            if not (live and gap > advance_slack and (lagging or churning)):
+                continue
+            h = cur_height
+            recv_counts = {c: st.recv_counts.get((h, c), 0)
+                           for c in PIPELINE_ORDER}
+            missing = [c for c in PIPELINE_ORDER if recv_counts[c] == 0]
+            first_missing = missing[0] if missing else None
+            silent_peers = sorted(
+                self.peer_names.get(p, str(p)) for p in st.peers_seen
+                if p is not None and st.precommit_peers.get((h, p), 0) == 0)
+            if tip > (st.committed or 0) and recv_counts["precommit"] == 0:
+                # catchup special case (traceview stall_report:474):
+                # peers are past this height, so finishing it needs the
+                # stored commit's precommits — and none arrived
+                if "precommit" in missing:
+                    first_missing = "precommit"
+                detail = (
+                    f"peers are at height {tip} but no catchup precommit "
+                    f"votes for height {h} ever arrived"
+                    + (f"; connected peers never gossiping them: "
+                       f"{', '.join(silent_peers)}" if silent_peers else "")
+                )
+            elif first_missing is not None:
+                detail = (f"no {first_missing} received at height {h} "
+                          f"(rounds reached {max_round})")
+            else:
+                detail = (f"all message classes seen at height {h} yet no "
+                          f"commit; rounds reached {max_round}")
+            stalled.append({
+                "node": st.name, "height": h, "committed": st.committed,
+                "max_round": max_round, "first_missing": first_missing,
+                "missing": missing, "recv_counts": recv_counts,
+                "silent_peers": silent_peers,
+                "stalled_for_s": round(gap, 3), "detail": detail,
+            })
+        return {
+            "status": "stall" if stalled else "ok",
+            "tip": tip or None,
+            "span_s": round(span, 3),
+            "nodes": nodes_out,
+            "stalled": stalled,
+        }

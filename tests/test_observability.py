@@ -609,3 +609,36 @@ def test_bench_compare_certnative_advisory_never_gates():
     assert p.returncode == 0, p.stderr
     assert "certnative" in p.stdout
     assert "bench_compare:" in p.stdout
+
+
+def test_bench_compare_watchtower_advisory_never_gates():
+    """tools/bench_compare.py --watchtower --advisory: the auditor leg
+    is informational for throughput, but its two absolute invariants —
+    zero false positives on the clean leg and audit-latency p99 inside
+    its budget — are checked against the CURRENT record regardless of
+    whether a baseline exists. rc 0 either way in advisory mode, and
+    the watchtower line always renders."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_compare.py"),
+         "--watchtower", "--advisory", "--threshold", "0.001"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    assert "watchtower" in p.stdout
+    assert "bench_compare:" in p.stdout
+
+
+def test_metrics_doc_is_current():
+    """tools/metrics_doc.py --check: METRICS.md is generated from the
+    registered bundles; a new or renamed metric without a regenerated
+    doc fails tier 1 here."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "metrics_doc.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr + p.stdout

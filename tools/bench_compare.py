@@ -686,6 +686,103 @@ def compare_certnative(ref: str, threshold: float,
     }
 
 
+def _workloads_record(flat_src: str, metric: str):
+    """A named record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        rec = data.get(metric)
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+# run geometry and the legs handled first-class (or non-numeric)
+_WT_SKIP = ("gate.", "detection.", "false_positives", "p99_budget_ms",
+            "nodes", "blocks", "validators")
+
+
+def compare_watchtower(ref: str, threshold: float,
+                       relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the watchtower audit workload (ISSUE 18): the audit
+    frame rate and latency distribution go through the directional
+    machinery; two invariants are first-class and independent of the
+    baseline — the clean-feed FALSE-POSITIVE count must be zero (a
+    baseline that also cried wolf would excuse nothing), and the
+    audit-latency p99 must stay inside the record's own absolute
+    budget (the auditor must remain cheap enough to run inline with a
+    live feed on this machine)."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    with open(cur_path) as f:
+        cur = _workloads_record(f.read(), "watchtower")
+    if cur is None:
+        return {"file": relpath, "skipped": "no watchtower record"}
+    base_text = _git_show(ref, relpath)
+    base = (_workloads_record(base_text, "watchtower")
+            if base_text is not None else None)
+
+    c_flat = _flatten(cur)
+    b_flat = _flatten(base) if base is not None else {}
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p == key for p in _WT_SKIP):
+            continue
+        d = direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    fp = {"key": "false_positives",
+          "baseline": b_flat.get("false_positives"),
+          "current": c_flat.get("false_positives", 0.0),
+          "worse": c_flat.get("false_positives", 0.0) > 0}
+    p99 = {"key": "audit_latency_p99_vs_budget_ms",
+           "baseline": b_flat.get("audit_latency_ms.p99"),
+           "current": c_flat.get("audit_latency_ms.p99", 0.0),
+           "budget": c_flat.get("p99_budget_ms", 0.0),
+           "worse": (c_flat.get("audit_latency_ms.p99", 0.0)
+                     > c_flat.get("p99_budget_ms", float("inf")))}
+    invariants = [fp, p99]
+    regs = [r for r in rows if r["worse"]]
+    regs += [i for i in invariants if i["worse"]]
+    return {
+        "file": relpath, "mode": "watchtower",
+        "invariants": invariants,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
+def _print_watchtower(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"watchtower: skipped ({rep['skipped']})")
+        return
+    broken = [i["key"] for i in rep["invariants"] if i["worse"]]
+    tag = "REGRESSION" if broken else "          "
+    print(f"watchtower ({rep['file']}): {tag} zero-false-positive/"
+          f"p99-budget invariants "
+          f"{'BROKEN: ' + ', '.join(broken) if broken else 'held'}")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-32s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_certnative(rep: dict) -> None:
     if "skipped" in rep:
         print(f"certnative: skipped ({rep['skipped']})")
@@ -820,6 +917,10 @@ def main(argv=None) -> int:
                     help="also diff the certificate-native workload "
                          "(cert-vs-column verdict pins and the one-"
                          "pairing-per-block replay invariant first-class)")
+    ap.add_argument("--watchtower", action="store_true",
+                    help="also diff the watchtower audit workload "
+                         "(zero-false-positive and audit-latency-p99-"
+                         "budget invariants first-class)")
     ap.add_argument("--ref", default="HEAD",
                     help="git ref holding the baseline (default HEAD)")
     ap.add_argument("--threshold", type=float, default=0.10,
@@ -845,9 +946,11 @@ def main(argv=None) -> int:
                 if args.replicas else None)
     cert_rep = (compare_certnative(args.ref, args.threshold)
                 if args.certnative else None)
+    wt_rep = (compare_watchtower(args.ref, args.threshold)
+              if args.watchtower else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
     for extra in (ingest_rep, bls_rep, das_rep, city_rep, repl_rep,
-                  cert_rep):
+                  cert_rep, wt_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -865,6 +968,8 @@ def main(argv=None) -> int:
         summary["city_replicated"] = repl_rep
     if cert_rep is not None:
         summary["certnative"] = cert_rep
+    if wt_rep is not None:
+        summary["watchtower"] = wt_rep
     if args.as_json:
         print(json.dumps(summary, indent=2))
     else:
@@ -896,6 +1001,8 @@ def main(argv=None) -> int:
             _print_replicated(repl_rep)
         if cert_rep is not None:
             _print_certnative(cert_rep)
+        if wt_rep is not None:
+            _print_watchtower(wt_rep)
         verdict = ("ADVISORY — not gating" if args.advisory
                    else ("FAIL" if n_reg else "OK"))
         print(f"bench_compare: {n_reg} regression(s) past "

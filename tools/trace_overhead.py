@@ -18,6 +18,12 @@ slower than baseline.
 keep trace sinks ON, the baseline disables hash-prefix tx sampling
 (COMETBFT_TPU_TXLIFE=0) and the compare run uses the production default
 rate (1/64) — isolating the sampler's own cost from the recorder's.
+
+`--watchtower` measures the streaming safety auditor (ISSUE 18): both
+runs keep trace sinks ON, the compare run additionally serves every
+node's replication feed and attaches the in-process Watchtower — so
+the measured cost is feed serving + auditing together, the full price
+of an audited world. Same <=5% block-rate budget.
 """
 
 from __future__ import annotations
@@ -46,7 +52,8 @@ def _world(nodes: int, height: int, timeout_s: float) -> Manifest:
 
 
 def _run_once(nodes: int, height: int, timeout_s: float,
-              trace: bool, txlife_rate: int | None = None) -> dict:
+              trace: bool, txlife_rate: int | None = None,
+              watchtower: bool = False) -> dict:
     if txlife_rate is not None:
         # both paths: env for subprocess node inheritance, configure()
         # for in-process worlds where txlife was imported long ago
@@ -56,7 +63,9 @@ def _run_once(nodes: int, height: int, timeout_s: float,
         txlife.configure(txlife_rate)
         txlife.reset()
     workdir = tempfile.mkdtemp(prefix="trace-overhead-")
-    r = Runner(_world(nodes, height, timeout_s), workdir, trace=trace)
+    m = _world(nodes, height, timeout_s)
+    m.watchtower = watchtower
+    r = Runner(m, workdir, trace=trace)
     try:
         r.setup()
         t0 = time.monotonic()
@@ -73,6 +82,11 @@ def _run_once(nodes: int, height: int, timeout_s: float,
             out["sink_bytes"] = sum(
                 os.path.getsize(p) for p in sinks.values())
             out["sinks"] = len(sinks)
+        if watchtower and r.watchtower is not None:
+            st = r.watchtower.status()
+            out["audited"] = {
+                name: n["audited"] for name, n in st["nodes"].items()}
+            out["verdicts"] = st["verdicts"]
         return out
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -91,12 +105,19 @@ def main(argv=None) -> int:
                     help="measure tx lifecycle sampling (1/64 vs off) "
                          "instead of the trace sinks themselves; both "
                          "runs keep sinks on")
+    ap.add_argument("--watchtower", action="store_true",
+                    help="measure feed serving + the attached streaming "
+                         "auditor instead of the sinks; both runs keep "
+                         "sinks on")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
     if args.lifecycle:
         base_kw = {"trace": True, "txlife_rate": 0}
         cmp_kw = {"trace": True, "txlife_rate": 64}
+    elif args.watchtower:
+        base_kw = {"trace": True}
+        cmp_kw = {"trace": True, "watchtower": True}
     else:
         base_kw = {"trace": False}
         cmp_kw = {"trace": True}
@@ -110,7 +131,8 @@ def main(argv=None) -> int:
     traced = max(r["blocks_per_s"] for r in results["traced"])
     degradation_pct = round((1.0 - traced / base) * 100.0, 2)
     summary = {
-        "mode": "lifecycle" if args.lifecycle else "trace",
+        "mode": ("lifecycle" if args.lifecycle
+                 else "watchtower" if args.watchtower else "trace"),
         "nodes": args.nodes, "target_height": args.height,
         "baseline_blocks_per_s": base, "traced_blocks_per_s": traced,
         "degradation_pct": degradation_pct,

@@ -765,6 +765,112 @@ def bench_certnative(n_vals=10_000, n_blocks=4):
     }
 
 
+def bench_watchtower(n_nodes=3, n_blocks=12, n_vals=4):
+    """ISSUE 18: the streaming safety auditor, measured offline on
+    synthetic feeds. One factory chain is served as N identical node
+    feeds through the auditor's ingest path; the clean leg records the
+    audit frame rate, the audit-latency distribution, and — the
+    first-class number — the false-positive count, which must be ZERO
+    (an auditor that cries wolf on a healthy net is worse than none).
+    The detection leg then forks one node's frame at the tip and
+    asserts the fork verdict names every double-signing validator and
+    the cross-column equivocation scan yields verified evidence — so a
+    zero in the clean leg means "nothing to find", not "not looking".
+    """
+    from cometbft_tpu.replication.feed import ReplicationFeed
+    from cometbft_tpu.utils import factories as fx
+    from cometbft_tpu.utils.metrics import reset_bundles
+    from cometbft_tpu.watchtower import Watchtower
+
+    if QUICK:
+        n_blocks = 6
+    chain_id = "watchtower-chain"
+    store, state, _genesis, signers = fx.make_chain(
+        n_blocks, n_vals, chain_id=chain_id)
+    vals = fx.make_validator_set(signers)
+    by_addr = {s.address(): s for s in signers}
+
+    class _Vals:
+        def load_validators(self, h):
+            return vals
+
+    feed = ReplicationFeed(chain_id, store, _Vals())
+    frames = [json.loads(feed._build_frame(store.load_block(h)))
+              for h in range(1, n_blocks + 1)]
+
+    # --- clean leg: N identical feeds, zero verdicts expected ----------
+    reset_bundles()
+    names = [f"node{i}" for i in range(n_nodes)]
+    wt = Watchtower({n: "" for n in names}, chain_id=chain_id,
+                    submit_evidence=False)
+    lats = []
+    t0 = time.perf_counter()
+    for frame in frames:
+        for name in names:
+            t1 = time.perf_counter()
+            wt.ingest_frame(name, frame)
+            lats.append(time.perf_counter() - t1)
+    clean_s = time.perf_counter() - t0
+    lats.sort()
+    false_positives = len(wt.verdicts)
+
+    def pct(p):
+        return round(lats[min(int(p * len(lats)), len(lats) - 1)] * 1e3, 3)
+
+    # --- detection leg: fork node1's tip frame -------------------------
+    wt2 = Watchtower({n: "" for n in names}, chain_id=chain_id,
+                     submit_evidence=False)
+    for frame in frames[:-1]:
+        for name in names:
+            wt2.ingest_frame(name, frame)
+    tip_frame = frames[-1]
+    wt2.ingest_frame("node0", tip_frame)
+    forked_commit = fx.make_commit(
+        chain_id, n_blocks, 0, fx.make_block_id(b"watchtower-fork"),
+        vals, by_addr)
+    forked = dict(tip_frame)
+    forked["seen"] = forked_commit.encode().hex()
+    wt2.ingest_frame("node1", forked)
+    det = {
+        "fork": sum(1 for v in wt2.verdicts if v["check"] == "fork"),
+        "equivocation": sum(
+            1 for v in wt2.verdicts if v["check"] == "equivocation"),
+        "culprits": max(
+            (len(v.get("culprits", ())) for v in wt2.verdicts
+             if v["check"] == "fork"), default=0),
+    }
+    gate = {"zero_false_positives": True, "asserted": True}
+    assert false_positives == 0, (
+        f"clean synthetic feeds raised {false_positives} verdict(s): "
+        f"{wt.verdicts[:3]}")
+    assert det["fork"] >= 1, "forked tip frame not detected"
+    assert det["culprits"] == n_vals, (
+        f"fork culprits {det['culprits']} != every signer {n_vals}")
+    assert det["equivocation"] >= 1, (
+        "cross-column equivocation scan produced no verified evidence")
+    frames_per_s = round(len(lats) / clean_s, 1)
+    print(f"  watchtower: {frames_per_s} frames/s audited, p99 "
+          f"{pct(0.99)} ms, 0 false positives, fork+equivocation "
+          f"detected", file=sys.stderr)
+    return {
+        "metric": "watchtower",
+        "value": frames_per_s,
+        "unit": "frames_per_sec",
+        "stat": "single_run",
+        "nodes": n_nodes,
+        "blocks": n_blocks,
+        "validators": n_vals,
+        "false_positives": false_positives,
+        "audit_latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+        # absolute per-machine budget the compare leg gates on: audit
+        # must stay cheap enough to run inline with a feed (this is a
+        # 1-core-CI-safe bound, not a perf target)
+        "p99_budget_ms": 250.0,
+        "detection": det,
+        "gate": gate,
+    }
+
+
 def _emit(rec):
     print(json.dumps(rec))
     sys.stdout.flush()
@@ -2276,6 +2382,11 @@ def main():
         return
     if "--certnative" in sys.argv:
         rec = bench_certnative()
+        _emit(rec)
+        _merge_workloads([rec])
+        return
+    if "--watchtower" in sys.argv:
+        rec = bench_watchtower()
         _emit(rec)
         _merge_workloads([rec])
         return
