@@ -299,12 +299,27 @@ class DAConfig:
     confidence: float = 0.99
     # extended-shard sets kept resident for serving samples
     retain_heights: int = 64
+    # 2D polynomial-commitment track (da/pc.py, ROADMAP #1): per-column
+    # KZG commitments + row/column erasure, bound into da_root via the
+    # combined 0x04 root. Constant 48 B multiproof openings replace the
+    # growing Merkle path; parity-linearity catches a lying encoder
+    # with no fraud proofs.
+    pc: bool = False
+    pc_data_cols: int = 4
+    pc_parity_cols: int = 4
+    # payloads needing more data rows than this skip the PC track for
+    # that height (opening cost scales with the column degree)
+    pc_max_rows: int = 1024
 
     def validate(self) -> None:
         from .da.rs import MAX_SHARDS
 
         if self.data_shards < 1 or self.parity_shards < 1:
             raise ValueError("da shard counts must be >= 1")
+        if self.pc_data_cols < 1 or self.pc_parity_cols < 1:
+            raise ValueError("da pc column counts must be >= 1")
+        if self.pc_max_rows < 1:
+            raise ValueError("da.pc_max_rows must be >= 1")
         if self.data_shards + self.parity_shards > MAX_SHARDS:
             raise ValueError(
                 f"da.data_shards + da.parity_shards must be <= {MAX_SHARDS}"

@@ -69,6 +69,14 @@ _HOST_RLC_US_NUMPY = 20.0    # numpy rlc.prepare, 1 core (r5 measured)
 _HOST_RLC_US_NATIVE = 1.1    # native packer, ONE worker (r6 measured);
 #                              scaled by rlc_packer_threads() at use
 _HOST_LADDER_US = 1.6        # ladder submit packing (r4: ~15-22 ms/10k)
+# BLS12-381 G1 Pippenger (csrc/g1_msm.inc): per-POINT host cost of the
+# worker-pool MSM, calibrated like the terms above. Carried in the
+# model as a third dispatch path for the crossover accounting in
+# PROFILE.md round-20 — the measured verdict is NEGATIVE for signature
+# dispatch (hundreds of us/point vs the ladder's 2.39 us/sig device
+# floor); the engine earns its keep on its own workload (KZG openings,
+# crypto/kzg.py), not here. r20 measured 393 us/point at n=256, 1 core.
+_HOST_MSM_US = 400.0
 _WIRE_LADDER_B = 96    # R||S||k per lane (73 on the delta fast path)
 # R (32) + A (32, re-shipped each submit: the RLC path keys its random
 # layout per batch, so there is no device-resident A cache analogue) +
@@ -129,6 +137,10 @@ def _calibrate_host_terms() -> dict:
         "rlc_native": rlc_native,
         "calibrated": False,
     }
+    # the MSM term exists only where the native engine does — there is
+    # no oracle fallback path worth modeling (three orders slower)
+    if native.g1_msm_available():
+        terms["msm_us"] = _HOST_MSM_US
     if _os.environ.get("COMETBFT_TPU_DISPATCH_CALIBRATE", "1") == "0":
         return terms
     try:
@@ -165,6 +177,23 @@ def _calibrate_host_terms() -> dict:
                 best = min(best, time.perf_counter() - t0)
             if okp:
                 terms["ladder_us"] = best / n * 1e6
+        if "msm_us" in terms:
+            import hashlib as _hl
+
+            from .bls import G1X, G1Y, g1_compress
+            nm = 256
+            pb = g1_compress((G1X, G1Y)) * nm
+            sb = b"".join(
+                b"\x00" + _hl.sha256(b"msm-cal%d" % i).digest()[1:]
+                for i in range(nm)
+            )  # 248-bit hash scalars are always < r
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                okm = native.g1_msm(sb, pb, nm)
+                best = min(best, time.perf_counter() - t0)
+            if isinstance(okm, bytes):
+                terms["msm_us"] = best / nm * 1e6
         terms["calibrated"] = True
     except Exception:
         return terms
@@ -180,6 +209,9 @@ def _host_terms() -> dict:
         cm = crypto_metrics()
         for term in ("ladder_us", "rlc_us"):
             cm.calibration_us_per_sig.set(_HOST_TERMS[term], term)
+        if "msm_us" in _HOST_TERMS:
+            cm.calibration_us_per_sig.set(
+                _HOST_TERMS["msm_us"], "msm_us")
         cm.calibration_us_per_sig.set(
             float(_HOST_TERMS.get("calibrated", False)), "calibrated"
         )
@@ -211,6 +243,21 @@ def dispatch_model(n: int, b: int) -> dict:
         "t_ladder": max(ladder.values()),
         "t_rlc": max(rlc.values()),
     }
+    if host.get("msm_us") is not None:
+        # Third path (round 20): fold the batch behind one BLS12-381
+        # G1 MSM on the native Pippenger engine. Host-only — nothing
+        # ships to the device, so wire and device terms vanish — but
+        # the per-point cost is hundreds of us against the ladder's
+        # 2.39 us/sig device floor, so the crossover never happens for
+        # signature dispatch at any n (the honest negative result in
+        # PROFILE.md round-20; the engine's win is KZG openings).
+        msm = {
+            "wire": 0.0,
+            "device": 0.0,
+            "host": n * host["msm_us"] * 1e-6,
+        }
+        out["msm"] = msm
+        out["t_msm"] = max(msm.values())
     eng = _mesh_engine()
     if eng is not None and eng.n_devices > 1:
         # Sharded-mesh term: the batch's device time splits d ways but

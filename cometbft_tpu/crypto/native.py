@@ -34,6 +34,7 @@ _SRC_DEPS = (
     os.path.join(os.path.dirname(_SRC), "sr25519_native.inc"),
     os.path.join(os.path.dirname(_SRC), "bls12_381.inc"),
     os.path.join(os.path.dirname(_SRC), "rs_gf16.inc"),
+    os.path.join(os.path.dirname(_SRC), "g1_msm.inc"),
 )
 _SO = os.path.join(os.path.dirname(__file__), "_ed25519_native.so")
 
@@ -254,6 +255,13 @@ def _bind(lib) -> None:
     ]
     lib.rs_gf16_threads.restype = ctypes.c_int
     lib.rs_gf16_threads.argtypes = []
+    lib.g1_msm_threads.restype = ctypes.c_int
+    lib.g1_msm_threads.argtypes = []
+    lib.g1_msm.restype = ctypes.c_int
+    lib.g1_msm.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,  # n, scalars, points
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,     # skip, nchunks, out
+    ]
     lib.rs_encode16.restype = ctypes.c_long
     lib.rs_encode16.argtypes = [
         ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,  # shard_len, k, m
@@ -940,3 +948,37 @@ def sr25519_ristretto_decode(enc: bytes):
         return False
     return (int.from_bytes(ox.raw, "little"),
             int.from_bytes(oy.raw, "little"))
+
+
+def g1_msm_available() -> bool:
+    """True when the native G1 Pippenger MSM engine is loadable."""
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "g1_msm")
+
+
+def g1_msm_threads() -> int:
+    """Worker count the MSM engine spreads a call across (1 when the
+    lib is absent — the Python oracle is single-core anyway). The
+    dispatch model divides its msm host term by this."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "g1_msm_threads"):
+        return 1
+    return max(1, int(lib.g1_msm_threads()))
+
+
+def g1_msm(scalars_blob: bytes, points_blob: bytes, n: int,
+           skip: bytes | None = None, nchunks: int = 0):
+    """sum scalars[i]*points[i] over BLS12-381 G1: n 32-byte big-endian
+    scalars against n zcash-compressed points, entries with a truthy
+    `skip` byte excluded without validation. Returns the 48-byte
+    compressed sum, False when the engine rejects the input (bad
+    point / scalar >= r on a live entry — the oracle rejects the same
+    inputs), or None when the lib is absent."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "g1_msm"):
+        return None
+    out = ctypes.create_string_buffer(48)
+    rc = lib.g1_msm(n, scalars_blob, points_blob, skip, nchunks, out)
+    if rc != 1:
+        return False
+    return out.raw

@@ -1359,3 +1359,8 @@ void ed25519_pack_rsk(u64 n, const u8 *sigs, const u8 *pubs, const u8 *msgs,
 // track (own extern "C" exports: rs_encode16, rs_reconstruct16,
 // rs_gf16_threads; uses the pool from rlc_packer.inc)
 #include "rs_gf16.inc"
+
+// BLS12-381 G1 Pippenger MSM — KZG polynomial-commitment opening
+// engine (own extern "C" exports: g1_msm, g1_msm_threads; uses the
+// G1 core from bls12_381.inc, pool from rlc_packer.inc)
+#include "g1_msm.inc"

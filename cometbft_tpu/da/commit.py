@@ -23,8 +23,11 @@ from ..crypto import merkle
 from .rs import RSError, encode_shards
 
 # same domain-separation discipline as light/mmr.py: 0x00/0x01 are
-# RFC-6962 leaf/inner (crypto/merkle), 0x02 binds the root metadata
+# RFC-6962 leaf/inner (crypto/merkle), 0x02 binds the root metadata,
+# 0x03 the polynomial-commitment root (da/pc.py), 0x04 the combined
+# header root when both tracks run
 ROOT_PREFIX = b"\x02"
+COMBINED_ROOT_PREFIX = b"\x04"
 
 _ROOT_FMT = ">IIQ"  # n, k, payload_len
 
@@ -114,6 +117,13 @@ def da_root_for_data(data, k: int, m: int, *, nchunks: int = 0) -> bytes:
     shards = extend_payload(payload, k, m, nchunks=nchunks)
     com, _ = commit_shards(shards, k, len(payload))
     return com.root()
+
+
+def combined_root(root_1d: bytes, pc_root: bytes) -> bytes:
+    """Header da_root when the polynomial-commitment track rides along
+    with the 1D RS track: one hash binding both, domain-separated so
+    neither single-track root can collide with it."""
+    return _sha256(COMBINED_ROOT_PREFIX + root_1d + pc_root)
 
 
 def proof_num_bytes(chunk: bytes, proof: merkle.Proof) -> int:

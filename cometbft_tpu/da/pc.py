@@ -1,0 +1,241 @@
+"""2D polynomial-commitment DA: per-column KZG + row/column erasure.
+
+The payload is chopped into 31-byte field chunks and laid out
+column-major into a k_r x k_c matrix of Fr scalars. Each data column j
+is the unique polynomial p_j of degree < k_r through its cells
+(rows are evaluation points 0..k_r-1); rows k_r..n_r-1 are the ROW
+extension (evaluating p_j past the data grid = a rate-1/2
+Reed-Solomon code per column). Parity COLUMNS k_c..n_c-1 are Lagrange
+combinations of the data columns evaluated at x = j', which commutes
+with everything linear: cells, coefficients, and — the part the 1D
+Merkle track cannot copy — the KZG commitments themselves.
+
+That last fact is the fraud-proof-free lying-encoder defence
+(`kzg.verify_parity_commitments`): a sampler checks ONCE per height,
+from the commitment list alone, that every parity commitment is the
+required linear combination of the data commitments. A Merkle root
+has no such structure — hashes of garbage parity verify every opening
+(pinned as the 1D-blindness test in tests/test_kzg_native.py).
+
+Sampling cost is where the multiproof earns its keep: one (row, s
+columns) sample is answered by s 32-byte evaluations plus ONE 48-byte
+opening (`kzg.open_multi`), so marginal bytes/sample approach 32 + eps
+instead of the 1D track's chunk + growing Merkle path (256 B at the
+default geometry). The per-height commitment list (n_c x 48 B) is the
+fixed overhead amortized across a client's samples.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from ..crypto import kzg
+from ..utils import trace
+
+# domain separation continues the DA ladder: 0x02 is the 1D root,
+# 0x03 the PC root, 0x04 the combined header root (da/commit.py)
+PC_ROOT_PREFIX = b"\x03"
+
+_PC_ROOT_FMT = ">IIIIQ"  # n_r, k_r, n_c, k_c, payload_len
+
+CHUNK_BYTES = 31  # 248-bit chunks embed injectively into Fr
+EVAL_SIZE = 32  # one claimed cell value on the wire
+SAMPLE_HEADER_BYTES = 12  # row + column-count + height framing
+
+
+def _sha256(b) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+@dataclass(frozen=True)
+class PCCommitment:
+    """Geometry + the per-column commitment list a sampler verifies
+    openings and parity-linearity against."""
+
+    n_r: int  # extended rows (k_r data + k_r row parity)
+    k_r: int  # data rows = column-polynomial degree bound
+    n_c: int  # extended columns (k_c data + m_c parity)
+    k_c: int  # data columns
+    payload_len: int  # unpadded payload bytes
+    commitments: tuple  # n_c compressed G1 points, 48 B each
+
+    @property
+    def m_c(self) -> int:
+        return self.n_c - self.k_c
+
+    def cols_root(self) -> bytes:
+        return _sha256(b"".join(self.commitments))
+
+    def root(self) -> bytes:
+        return _sha256(
+            PC_ROOT_PREFIX
+            + struct.pack(_PC_ROOT_FMT, self.n_r, self.k_r,
+                          self.n_c, self.k_c, self.payload_len)
+            + self.cols_root()
+        )
+
+    def num_bytes(self) -> int:
+        """Per-height wire overhead a sampling client downloads once:
+        the commitment list plus the packed geometry."""
+        return len(self.commitments) * kzg.POINT_SIZE + 24
+
+
+def multiproof_num_bytes(n_cols: int) -> int:
+    """Wire cost of one (row, n_cols columns) sample response: the
+    claimed evaluations plus ONE constant-size opening. Counterpart of
+    commit.proof_num_bytes on the 1D track."""
+    return n_cols * EVAL_SIZE + kzg.PROOF_SIZE + SAMPLE_HEADER_BYTES
+
+
+def payload_to_scalars(payload: bytes) -> list[int]:
+    """31-byte big-endian chunks — each strictly < r, so the embedding
+    is injective and needs no reduction. The tail chunk is zero-padded
+    on the RIGHT so decode's fixed-width re-serialization lines up."""
+    return [
+        int.from_bytes(
+            payload[off:off + CHUNK_BYTES].ljust(CHUNK_BYTES, b"\x00"),
+            "big")
+        for off in range(0, len(payload), CHUNK_BYTES)
+    ]
+
+
+def scalars_to_payload(scalars, payload_len: int) -> bytes:
+    out = b"".join(s.to_bytes(CHUNK_BYTES, "big") for s in scalars)
+    return out[:payload_len]
+
+
+def grid_rows(payload_len: int, k_c: int) -> int:
+    """k_r for a payload: column-major fill of 31-byte chunks across
+    k_c data columns, at least one row."""
+    chunks = max(1, -(-payload_len // CHUNK_BYTES))
+    return max(1, -(-chunks // k_c))
+
+
+class PCEncoding:
+    """One height's full 2D encoding: cell matrix, column polynomials
+    and commitments. The serving node retains this; samplers only ever
+    see the PCCommitment plus (ys, proof) responses."""
+
+    __slots__ = ("com", "col_coeffs", "cells")
+
+    def __init__(self, com: PCCommitment, col_coeffs, cells):
+        self.com = com
+        self.col_coeffs = col_coeffs  # n_c lists, each deg < k_r
+        self.cells = cells  # n_c columns x n_r rows of Fr ints
+
+    def open_row_cols(self, row: int, cols, *, force_oracle=False):
+        """(ys, proof48) for one multiproof sample: the claimed cells
+        plus a single aggregated opening at z = row."""
+        polys = [self.col_coeffs[j] for j in cols]
+        coms = [self.com.commitments[j] for j in cols]
+        return kzg.open_multi(polys, coms, row,
+                              force_oracle=force_oracle)
+
+
+def pc_encode(payload: bytes, k_c: int, m_c: int,
+              srs: kzg.SRS | None = None) -> PCEncoding:
+    """Encode + commit one payload on the 2D track.
+
+    Data columns are interpolated from their column-major chunk cells;
+    parity columns are Lagrange combinations of the data columns (same
+    weights for coefficients and cells — linearity). Commitments are
+    one MSM per column against the SRS powers."""
+    n_c = k_c + m_c
+    k_r = grid_rows(len(payload), k_c)
+    n_r = 2 * k_r
+    srs = (srs or kzg.setup(k_r)).grown(k_r)
+    scalars = payload_to_scalars(payload)
+    scalars += [0] * (k_r * k_c - len(scalars))
+    xs_rows = list(range(k_r))
+    with trace.span("da.pc_commit", rows=n_r, cols=n_c,
+                    bytes=len(payload)):
+        col_coeffs = []
+        for j in range(k_c):
+            ys = scalars[j * k_r:(j + 1) * k_r]
+            col_coeffs.append(kzg.interpolate(xs_rows, ys))
+        xs_cols = list(range(k_c))
+        for jp in range(k_c, n_c):
+            lam = kzg.lagrange_coeffs_at(xs_cols, jp)
+            coeffs = [0] * k_r
+            for j in range(k_c):
+                cj = col_coeffs[j]
+                for d in range(len(cj)):
+                    coeffs[d] = (coeffs[d] + lam[j] * cj[d]) % kzg.R
+            col_coeffs.append(coeffs)
+        commitments = tuple(
+            kzg.commit(c, srs) for c in col_coeffs
+        )
+        cells = [
+            [kzg.poly_eval(c, i) for i in range(n_r)]
+            for c in col_coeffs
+        ]
+    com = PCCommitment(n_r=n_r, k_r=k_r, n_c=n_c, k_c=k_c,
+                       payload_len=len(payload),
+                       commitments=commitments)
+    return PCEncoding(com, col_coeffs, cells)
+
+
+def decode_payload(enc: PCEncoding) -> bytes:
+    """Payload back out of the data quadrant (tests/roundtrip)."""
+    com = enc.com
+    scalars = []
+    for j in range(com.k_c):
+        scalars.extend(enc.cells[j][:com.k_r])
+    return scalars_to_payload(scalars, com.payload_len)
+
+
+def verify_sample(com: PCCommitment, pc_root: bytes, row: int, cols,
+                  ys, proof: bytes) -> bool:
+    """Client-side check of one multiproof response: geometry binds to
+    the advertised root, the row/columns are in range, and the single
+    opening verifies against the sampled columns' commitments."""
+    if com.root() != pc_root:
+        return False
+    if not (0 <= row < com.n_r) or not cols or len(cols) != len(ys):
+        return False
+    if any(not (0 <= j < com.n_c) for j in cols):
+        return False
+    coms = [com.commitments[j] for j in cols]
+    return kzg.verify_multi(coms, row, ys, proof)
+
+
+def verify_commitments(com: PCCommitment) -> bool:
+    """The once-per-height lying-encoder check (see module docstring):
+    parity commitments must be the Lagrange combinations of the data
+    commitments — one batched MSM, no samples needed."""
+    return kzg.verify_parity_commitments(list(com.commitments), com.k_c)
+
+
+def make_inconsistent(enc: PCEncoding, seed: int = 0) -> PCEncoding:
+    """The adversarial world: a proposer that commits HONESTLY to
+    garbage parity columns. Every opening against the published
+    commitments verifies — only the parity-linearity check (2D) or
+    downstream reconstruction (too late) can tell. The 1D analogue
+    (garbage parity shards under an honest Merkle root) is provably
+    undetectable by opening samples; the paired tests pin both."""
+    com = enc.com
+    col_coeffs = [list(c) for c in enc.col_coeffs]
+    for jp in range(com.k_c, com.n_c):
+        h = hashlib.sha256(struct.pack(">QI", seed, jp)).digest()
+        col_coeffs[jp] = [
+            int.from_bytes(
+                hashlib.sha256(h + struct.pack(">I", d)).digest(), "big"
+            ) % kzg.R
+            for d in range(com.k_r)
+        ]
+    commitments = tuple(
+        enc.com.commitments[:com.k_c]
+        + tuple(kzg.commit(col_coeffs[jp], kzg.setup(com.k_r))
+                for jp in range(com.k_c, com.n_c))
+    )
+    cells = [
+        [kzg.poly_eval(c, i) for i in range(com.n_r)]
+        for c in col_coeffs
+    ]
+    bad_com = PCCommitment(
+        n_r=com.n_r, k_r=com.k_r, n_c=com.n_c, k_c=com.k_c,
+        payload_len=com.payload_len, commitments=commitments,
+    )
+    return PCEncoding(bad_com, col_coeffs, cells)

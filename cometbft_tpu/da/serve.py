@@ -23,9 +23,11 @@ from collections import OrderedDict
 
 from ..utils import trace
 from ..utils.metrics import da_metrics
+from . import pc as pcmod
 from .commit import (
     DACommitment,
     block_payload,
+    combined_root,
     commit_shards,
     extend_payload,
     proof_num_bytes,
@@ -33,13 +35,16 @@ from .commit import (
 
 
 class _HeightEntry:
-    __slots__ = ("commitment", "shards", "proofs", "da_root")
+    __slots__ = ("commitment", "shards", "proofs", "da_root", "pc")
 
-    def __init__(self, commitment, shards, proofs):
+    def __init__(self, commitment, shards, proofs, pc=None):
         self.commitment = commitment
         self.shards = shards
         self.proofs = proofs
-        self.da_root = commitment.root()
+        self.pc = pc  # PCEncoding when the 2D KZG track is on
+        root = commitment.root()
+        self.da_root = (root if pc is None
+                        else combined_root(root, pc.com.root()))
 
 
 class DAServe:
@@ -48,12 +53,20 @@ class DAServe:
         self.cfg = cfg
         self.k = cfg.data_shards
         self.m = cfg.parity_shards
+        self.pc_enabled = bool(getattr(cfg, "pc", False))
+        self.pc_k_c = getattr(cfg, "pc_data_cols", 4)
+        self.pc_m_c = getattr(cfg, "pc_parity_cols", 4)
+        self.pc_max_rows = getattr(cfg, "pc_max_rows", 1024)
         self._lock = threading.Lock()
         self._heights: OrderedDict[int, _HeightEntry] = OrderedDict()
         self._withhold: dict[int, set[int]] = {}
+        self._pc_withhold: dict[int, set[int]] = {}
         self._encoded = 0
         self._served = 0
         self._withheld_hits = 0
+        self._pc_served = 0
+        self._pc_withheld_hits = 0
+        self._pc_skipped_rows = 0
         self.metrics = da_metrics()
 
     # --------------------------------------------------------- encoder side
@@ -63,7 +76,25 @@ class DAServe:
         payload = block_payload(data)
         shards = extend_payload(payload, self.k, self.m)
         com, _ = commit_shards(shards, self.k, len(payload))
-        return com.root()
+        root = com.root()
+        enc = self._pc_encode(payload)
+        if enc is not None:
+            return combined_root(root, enc.com.root())
+        return root
+
+    def _pc_encode(self, payload: bytes):
+        """The 2D KZG encoding for one payload, or None when the track
+        is off / the payload exceeds the row budget (a commitment per
+        column is cheap; the SRS and opening costs scale with rows)."""
+        if not self.pc_enabled:
+            return None
+        if pcmod.grid_rows(len(payload), self.pc_k_c) > self.pc_max_rows:
+            with self._lock:
+                self._pc_skipped_rows += 1
+            return None
+        enc = pcmod.pc_encode(payload, self.pc_k_c, self.pc_m_c)
+        self.metrics.pc_commits_total.inc()
+        return enc
 
     def on_commit(self, block, resp=None) -> None:
         """Commit-time hook (same contract as LightServe.on_commit):
@@ -84,13 +115,15 @@ class DAServe:
             shards = extend_payload(payload, self.k, self.m)
             com, proofs = commit_shards(shards, self.k, len(payload))
             sp.add(shards=com.n, shard_bytes=len(shards[0]))
-        entry = _HeightEntry(com, shards, proofs)
+        entry = _HeightEntry(com, shards, proofs,
+                             pc=self._pc_encode(payload))
         with self._lock:
             self._heights[height] = entry
             self._encoded += 1
             while len(self._heights) > self.cfg.retain_heights:
                 h, _ = self._heights.popitem(last=False)
                 self._withhold.pop(h, None)
+                self._pc_withhold.pop(h, None)
         return entry
 
     # --------------------------------------------------------- serving side
@@ -98,6 +131,31 @@ class DAServe:
         """Adversarial harness: refuse to serve `indices` at `height`."""
         with self._lock:
             self._withhold[height] = set(indices)
+
+    def set_pc_withholding(self, height: int, cols) -> None:
+        """Adversarial harness, 2D track: refuse any multiproof sample
+        touching one of `cols` at `height`."""
+        with self._lock:
+            self._pc_withhold[height] = set(cols)
+
+    def corrupt_pc_parity(self, height: int, seed: int = 0) -> bool:
+        """Adversarial harness: swap in the lying-encoder world —
+        honest commitments over garbage parity columns, every opening
+        still verifying (da/pc.py make_inconsistent). The entry's
+        da_root IS recomputed: this models a proposer that built and
+        advertised the block with garbage parity from the start, so
+        every opening a sampler draws verifies against the advertised
+        commitments and ONLY the parity-linearity check
+        (`pc.verify_commitments`) catches it — the world the 2D design
+        exists for."""
+        with self._lock:
+            entry = self._heights.get(height)
+        if entry is None or entry.pc is None:
+            return False
+        entry.pc = pcmod.make_inconsistent(entry.pc, seed)
+        entry.da_root = combined_root(
+            entry.commitment.root(), entry.pc.com.root())
+        return True
 
     def stream_fields(self, height: int) -> dict:
         """/light_stream payload extension for one height ({} when the
@@ -107,12 +165,19 @@ class DAServe:
         if entry is None:
             return {}
         com = entry.commitment
-        return {
+        out = {
             "da_root": entry.da_root.hex(),
             "da_shards": com.n,
             "da_data_shards": com.k,
             "da_payload_len": com.payload_len,
         }
+        if entry.pc is not None:
+            pcc = entry.pc.com
+            out["da_pc_root"] = pcc.root().hex()
+            out["da_pc_rows"] = pcc.n_r
+            out["da_pc_cols"] = pcc.n_c
+            out["da_pc_data_cols"] = pcc.k_c
+        return out
 
     def sample(self, height: int, index: int):
         """(chunk, Proof, DACommitment) for one sampled index, or None
@@ -138,6 +203,46 @@ class DAServe:
                 self._served += 1
         return chunk, proof, entry.commitment
 
+    def pc_sample(self, height: int, row: int, cols):
+        """(ys, proof48) answering one multiproof sample — `cols` are
+        the client's sampled column indices, all opened at `row` by a
+        single aggregated proof. None when the height is unknown, the
+        track is off for it, the geometry is out of range, or any
+        requested column is withheld."""
+        with self._lock:
+            entry = self._heights.get(height)
+            withheld = self._pc_withhold.get(height, ())
+        if entry is None or entry.pc is None:
+            return None
+        com = entry.pc.com
+        cols = list(cols)
+        if not cols or not (0 <= row < com.n_r):
+            return None
+        if any(not (0 <= j < com.n_c) for j in cols):
+            return None
+        if any(j in withheld for j in cols):
+            with self._lock:
+                self._pc_withheld_hits += 1
+            return None
+        nbytes = pcmod.multiproof_num_bytes(len(cols))
+        with trace.span(
+            "da.serve_sample", height=height, index=row,
+            cols=len(cols), bytes=nbytes, track="pc",
+        ):
+            ys, proof = entry.pc.open_row_cols(row, cols)
+            self.metrics.pc_samples_served_total.inc()
+            self.metrics.pc_proof_bytes.observe(nbytes)
+            with self._lock:
+                self._pc_served += 1
+        return ys, proof
+
+    def pc_commitments(self, height: int):
+        """The height's PCCommitment (geometry + per-column KZG
+        commitment list), or None off-track."""
+        with self._lock:
+            entry = self._heights.get(height)
+        return entry.pc.com if entry is not None and entry.pc else None
+
     def commitment(self, height: int) -> DACommitment | None:
         with self._lock:
             entry = self._heights.get(height)
@@ -161,9 +266,14 @@ class DAServe:
                 "blocks_encoded": self._encoded,
                 "samples_served": self._served,
                 "withheld_hits": self._withheld_hits,
+                "pc_enabled": self.pc_enabled,
+                "pc_samples_served": self._pc_served,
+                "pc_withheld_hits": self._pc_withheld_hits,
+                "pc_skipped_rows": self._pc_skipped_rows,
             }
 
     def stop(self) -> None:
         with self._lock:
             self._heights.clear()
             self._withhold.clear()
+            self._pc_withhold.clear()

@@ -949,6 +949,57 @@ def da_sample(env, params):
     }
 
 
+def da_pc_commitments(env, params):
+    """The 2D polynomial-commitment track's per-height commitment list:
+    grid geometry plus one compressed KZG commitment per column. A
+    sampling client downloads this once per height, runs the
+    parity-linearity (lying-encoder) check, then verifies constant-size
+    multiproof openings from da_pc_sample against it."""
+    srv = _da_serve(env)
+    try:
+        h = int(params.get("height", 0))
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, "invalid height") from e
+    com = srv.pc_commitments(h)
+    if com is None:
+        raise RPCError(-32603, f"no pc commitment for height {h}")
+    return {
+        "height": str(h),
+        "rows": com.n_r,
+        "data_rows": com.k_r,
+        "cols": com.n_c,
+        "data_cols": com.k_c,
+        "payload_len": str(com.payload_len),
+        "commitments": [c.hex() for c in com.commitments],
+        "pc_root": _hx(com.root()),
+    }
+
+
+def da_pc_sample(env, params):
+    """One multiproof sample: every requested column opened at `row`
+    by s 32-byte evaluations plus ONE 48-byte aggregated KZG proof
+    (da/pc.py). `cols` is comma-separated column indices."""
+    srv = _da_serve(env)
+    try:
+        h = int(params.get("height", 0))
+        row = int(params.get("row", -1))
+        cols = [int(c) for c in str(params.get("cols", "")).split(",")]
+    except (TypeError, ValueError) as e:
+        raise RPCError(-32602, "invalid height/row/cols") from e
+    got = srv.pc_sample(h, row, cols)
+    if got is None:
+        raise RPCError(
+            -32603, f"no pc sample for height {h} row {row}")
+    ys, proof = got
+    return {
+        "height": str(h),
+        "row": row,
+        "cols": cols,
+        "ys": ["%064x" % y for y in ys],
+        "proof": proof.hex(),
+    }
+
+
 def _replication_feed(env):
     feed = getattr(env, "replication_feed", None)
     if feed is None:
@@ -1059,6 +1110,8 @@ ROUTES = {
     "light_bisect": light_bisect,
     "da_status": da_status,
     "da_sample": da_sample,
+    "da_pc_commitments": da_pc_commitments,
+    "da_pc_sample": da_pc_sample,
     "replication_status": replication_status,
     "replication_snapshot": replication_snapshot,
     "replication_snapshot_chunk": replication_snapshot_chunk,
@@ -1078,6 +1131,8 @@ REPLICA_ROUTES = {
         "light_bisect",
         "da_status",
         "da_sample",
+        "da_pc_commitments",
+        "da_pc_sample",
         "broadcast_tx_sync",
         "broadcast_tx_async",
         "replication_status",

@@ -508,6 +508,16 @@ class DAMetrics:
         self.reconstruct_total = reg.counter(
             "da", "reconstruct_total",
             "Reed-Solomon reconstructions attempted from sampled shards")
+        self.pc_commits_total = reg.counter(
+            "da", "pc_commits_total",
+            "Payloads committed on the 2D polynomial-commitment track")
+        self.pc_samples_served_total = reg.counter(
+            "da", "pc_samples_served_total",
+            "Multiproof (row, columns) samples served to DAS clients")
+        self.pc_proof_bytes = reg.histogram(
+            "da", "pc_proof_bytes",
+            "Per-sample multiproof response sizes (evals + one opening)",
+            buckets=self.PROOF_BUCKETS)
 
 
 class CryptoMetrics:
@@ -530,6 +540,14 @@ class CryptoMetrics:
         self.calibration_us_per_sig = reg.gauge(
             "crypto", "calibration_us_per_sig",
             "Calibrated host-stage dispatch terms", labels=("term",))
+        self.msm_native_total = reg.counter(
+            "crypto", "msm_native_total",
+            "G1 multi-scalar multiplications run on the native "
+            "Pippenger engine")
+        self.msm_oracle_total = reg.counter(
+            "crypto", "msm_oracle_total",
+            "G1 multi-scalar multiplications that fell back to the "
+            "Python oracle")
         self.mesh_devices = reg.gauge(
             "crypto", "mesh_devices",
             "Device count of the active verify mesh (0/absent = mesh off)")

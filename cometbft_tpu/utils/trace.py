@@ -338,6 +338,8 @@ SPAN_REGISTRY = {
     "da.encode": "one committed payload erasure-coded + committed (height/bytes/shards/shard_bytes)",
     "da.serve_sample": "one extended-chunk opening served to a sampling client (height/index)",
     "da.sample_verify": "one sample proof verified against the header's da_root (index/n/ok)",
+    "da.pc_commit": "one payload committed on the 2D KZG track: per-column commitments + parity extension (height/rows/cols/bytes)",
+    "crypto.msm_opening": "one KZG opening-proof quotient committed via G1 MSM (n/cols)",
     "replication.feed_send": "one committed height's frame fanned out on the replication feed (height/subs/bytes)",
     "replication.replica_apply": "one feed frame applied into replica serving state (height/da/dur_ms)",
     "consensus.conflicting_vote": "conflicting signed votes from one validator at one HRS (height/round/type/vote_a/vote_b hex) — the watchtower's equivocation feed",

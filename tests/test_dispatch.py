@@ -411,6 +411,68 @@ def test_rlc_selected_on_loopback_with_real_calibration(monkeypatch):
     assert m["rlc"]["wire"] < m["t_rlc"]
 
 
+def _pin_model_msm(monkeypatch, link_mbps, rlc_us, msm_us,
+                   ladder_us=1.6):
+    e = _pin_model(monkeypatch, link_mbps, rlc_us, ladder_us)
+    e._HOST_TERMS["msm_us"] = float(msm_us)
+    return e
+
+
+def test_msm_path_absent_without_engine(monkeypatch):
+    """A host without the native MSM engine models two paths exactly as
+    before round 20 — no msm block, no t_msm."""
+    e = _pin_model(monkeypatch, link_mbps=1000.0, rlc_us=1.1)
+    m = e.dispatch_model(10000, 10240)
+    assert "msm" not in m and "t_msm" not in m
+
+
+def test_msm_path_shape(monkeypatch):
+    """The MSM path is host-only: nothing ships to a device, so wire
+    and device terms are zero and t_msm is the pure host fold cost."""
+    e = _pin_model_msm(monkeypatch, link_mbps=1000.0, rlc_us=1.1,
+                       msm_us=400.0)
+    m = e.dispatch_model(10000, 10240)
+    assert m["msm"]["wire"] == 0.0 and m["msm"]["device"] == 0.0
+    assert m["t_msm"] == pytest.approx(10000 * 400.0e-6)
+
+
+def test_msm_crossover_negative_at_every_batch_size(monkeypatch):
+    """The round-20 crossover verdict, pinned with the measured terms
+    (393 us/point at n=256 on the reference box): the ladder-vs-RLC-vs-
+    MSM three-way pick NEVER selects MSM for signature dispatch — its
+    host fold is ~170x the ladder's 2.39 us/sig device floor, and
+    scaling n only scales both linearly. The engine's win is the KZG
+    opening workload (WORKLOADS.json das_pc_multiproof), not this one."""
+    e = _pin_model_msm(monkeypatch, link_mbps=1000.0, rlc_us=1.1,
+                       msm_us=393.0)
+    for n in (64, 256, 1024, 4096, 10240, 65536):
+        m = e.dispatch_model(n, n)
+        assert m["t_msm"] > m["t_ladder"], n
+        assert m["t_msm"] > m["t_rlc"], n
+    # even a 100x-parallel fantasy engine loses above the smallest tier
+    e2 = _pin_model_msm(monkeypatch, link_mbps=1000.0, rlc_us=1.1,
+                        msm_us=3.93)
+    m = e2.dispatch_model(10240, 10240)
+    assert m["t_msm"] > m["t_ladder"]
+
+
+@needs_native
+def test_msm_term_calibrates_with_engine(monkeypatch):
+    """Fresh calibration on a host with the native MSM engine measures
+    a real msm_us and dispatch_model grows the third path."""
+    from cometbft_tpu.crypto import ed25519 as e
+
+    if not native.g1_msm_available():
+        pytest.skip("no native G1 MSM engine")
+    monkeypatch.setattr(e, "_HOST_TERMS", None)
+    terms = e._host_terms()
+    assert terms["calibrated"] and terms["msm_us"] > 0
+    m = e.dispatch_model(1024, 1024)
+    assert m["t_msm"] == pytest.approx(1024 * terms["msm_us"] * 1e-6)
+    # the negative result holds under REAL calibration too
+    assert m["t_msm"] > m["t_ladder"]
+
+
 def test_rlc_stream_length_is_tiered():
     """The wire stream must be padded to a coarse length tier: its true
     length varies with each batch's random z digits, and a distinct jit

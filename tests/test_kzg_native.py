@@ -1,0 +1,620 @@
+"""Multiproof-DAS KZG track (ISSUE 19): native-vs-oracle differential
+conformance for the G1 Pippenger MSM engine (accept AND reject paths,
+edge/padded shapes, chunk-count invariance, seeded fuzz), adversarial
+commit/open/verify cases (tampered proofs, wrong points, non-subgroup
+and identity inputs), the batched multiproof transcript, and the
+PINNED inconsistent-encoding pair: the 2D parity-linearity check
+catches a lying encoder that the 1D Merkle track is provably blind to.
+"""
+
+import hashlib
+import random
+import struct
+from unittest import mock
+
+import pytest
+
+from cometbft_tpu.config import DAConfig
+from cometbft_tpu.crypto import kzg, native
+from cometbft_tpu.crypto.bls import (
+    G1X,
+    G1Y,
+    P,
+    _g1_affine,
+    _g1_mul,
+    g1_compress,
+    g1_decompress,
+    g1_subgroup_check,
+)
+from cometbft_tpu.da import pc
+from cometbft_tpu.da.commit import combined_root, commit_shards, split_payload
+from cometbft_tpu.da.sampler import PCSampler, Sampler
+from cometbft_tpu.da.serve import DAServe
+from cometbft_tpu.rpc.client import LocalClient
+from cometbft_tpu.rpc.routes import Env, RPCError
+
+HAVE_MSM = native.g1_msm_available()
+
+R = kzg.R
+INF = kzg.G1_INF
+
+
+def _pt(i: int) -> bytes:
+    """Compressed [i]G1 (the identity for i == 0)."""
+    if i % R == 0:
+        return INF
+    return g1_compress(_g1_affine(_g1_mul(i % R, (G1X, G1Y, 1))))
+
+
+def _sblob(scalars) -> bytes:
+    return b"".join(s.to_bytes(32, "big") for s in scalars)
+
+
+def _det_scalars(n: int, tag: bytes = b"s") -> list:
+    return [
+        int.from_bytes(
+            hashlib.sha256(tag + struct.pack(">I", i)).digest(), "big"
+        ) % R
+        for i in range(n)
+    ]
+
+
+def _native_msm(sb, pb, n, skip=None, nchunks=0):
+    out = native.g1_msm(sb, pb, n, skip=skip, nchunks=nchunks)
+    assert out is not None, "native MSM engine vanished mid-test"
+    return out
+
+
+def oracle_only():
+    """Force every kzg MSM through the pure-Python oracle."""
+    return mock.patch.object(native, "g1_msm",
+                             lambda *a, **k: None)
+
+
+# ------------------------------------------------ MSM differential
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_native_matches_oracle_shapes():
+    """Bit-exact agreement on accept paths across sizes that exercise
+    every chunking boundary (single entry, partial chunks, multiples)."""
+    for n in (1, 2, 3, 4, 7, 8, 15, 16, 33):
+        scalars = _det_scalars(n)
+        pb = b"".join(_pt(i + 1) for i in range(n))
+        sb = _sblob(scalars)
+        got = _native_msm(sb, pb, n)
+        want = kzg.g1_msm_oracle(sb, pb, n)
+        assert got == want, f"n={n}"
+        assert got is not False and want is not None
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_chunk_count_invariant():
+    """The contiguous-segment emission makes the result independent of
+    the worker chunk count — pinned across awkward splits."""
+    n = 33
+    sb = _sblob(_det_scalars(n, b"chunk"))
+    pb = b"".join(_pt(i + 1) for i in range(n))
+    base = _native_msm(sb, pb, n, nchunks=1)
+    for nchunks in (0, 2, 3, 5, 8, 33):
+        assert _native_msm(sb, pb, n, nchunks=nchunks) == base
+    assert kzg.g1_msm_oracle(sb, pb, n) == base
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_skip_semantics():
+    """Skipped entries are never decoded: junk scalars/points under a
+    skip flag cannot reject the call, and a partially-skipped call
+    equals the dense call over the surviving entries."""
+    n = 8
+    scalars = _det_scalars(n, b"skip")
+    points = [_pt(i + 1) for i in range(n)]
+    # poison the odd lanes with garbage that would reject if decoded
+    for i in range(1, n, 2):
+        scalars[i] = R + i  # >= r
+        points[i] = b"\xee" * 48  # not a valid encoding
+    sb, pb = _sblob(scalars), b"".join(points)
+    skip = bytes(1 if i % 2 else 0 for i in range(n))
+    got = _native_msm(sb, pb, n, skip=skip)
+    dense_sb = _sblob([scalars[i] for i in range(0, n, 2)])
+    dense_pb = b"".join(points[i] for i in range(0, n, 2))
+    want = kzg.g1_msm_oracle(dense_sb, dense_pb, n // 2)
+    assert got == want
+    assert kzg.g1_msm_oracle(sb, pb, n, skip=skip) == want
+    # everything skipped: the identity, accepted, junk never touched
+    assert _native_msm(b"\xee" * (32 * n), b"\xee" * (48 * n), n,
+                       skip=b"\x01" * n) == INF
+    assert kzg.g1_msm_oracle(b"\xee" * (32 * n), b"\xee" * (48 * n), n,
+                             skip=b"\x01" * n) == INF
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_edge_entries():
+    """n == 0, zero scalars, identity points, and the top scalar r-1
+    all accept and agree with the oracle."""
+    assert _native_msm(b"", b"", 0) == INF
+    assert kzg.g1_msm_oracle(b"", b"", 0) == INF
+    cases = [
+        ([0], [_pt(3)], INF),  # zero scalar contributes nothing
+        ([5], [INF], INF),  # identity point contributes nothing
+        ([0, 7], [_pt(2), _pt(3)], _pt(21)),
+        ([R - 1], [_pt(1)], _pt(R - 1)),  # top of the scalar range
+        ([1, 1, 1], [_pt(4), INF, _pt(6)], _pt(10)),
+    ]
+    for scalars, points, want in cases:
+        sb, pb = _sblob(scalars), b"".join(points)
+        assert _native_msm(sb, pb, len(scalars)) == want
+        assert kzg.g1_msm_oracle(sb, pb, len(scalars)) == want
+
+
+def _non_subgroup_point() -> bytes:
+    """A canonical compressed point on E(Fp) but OUTSIDE the r-order
+    subgroup (the cofactor is ~2^125, so x-sweeping finds one fast)."""
+    for x in range(1, 200):
+        y2 = (x * x * x + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P != y2:
+            continue
+        pt = (x, y)
+        if not g1_subgroup_check(pt):
+            comp = g1_compress(pt)
+            assert g1_decompress(comp) == pt
+            return comp
+    raise AssertionError("no non-subgroup point found in sweep")
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_reject_paths():
+    """A single bad NON-skipped entry rejects the whole call — native
+    (False) and oracle (None) agree even when its scalar is zero."""
+    good_s, good_p = _sblob([3]), _pt(2)
+    bad_entries = [
+        (_sblob([R]), good_p),  # scalar == r
+        (_sblob([R + 12345]), good_p),  # scalar > r
+        (good_s, b"\x00" * 48),  # not a canonical encoding
+        (good_s, b"\xff" * 48),  # invalid flag bits
+        (good_s, _non_subgroup_point()),  # on curve, wrong subgroup
+        (_sblob([0]), _non_subgroup_point()),  # bad point, zero scalar
+    ]
+    for sb, pb in bad_entries:
+        assert native.g1_msm(sb, pb, 1) is False
+        assert kzg.g1_msm_oracle(sb, pb, 1) is None
+        # same entry embedded in an otherwise-valid batch still rejects
+        sb2 = _sblob([7]) + sb
+        pb2 = _pt(5) + pb
+        assert native.g1_msm(sb2, pb2, 2) is False
+        assert kzg.g1_msm_oracle(sb2, pb2, 2) is None
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_fuzz_differential():
+    """Seeded fuzz over mixed valid/invalid/skipped batches: the native
+    engine and the oracle must agree on the result AND on the verdict."""
+    rng = random.Random(0x6B7A67)
+    bad_point = _non_subgroup_point()
+    for _ in range(30):
+        n = rng.randrange(0, 17)
+        scalars, points, skip = [], [], []
+        for _ in range(n):
+            roll = rng.random()
+            if roll < 0.75:
+                scalars.append(rng.randrange(0, R))
+                points.append(_pt(rng.randrange(0, 50)))
+            elif roll < 0.85:
+                scalars.append(R + rng.randrange(0, 1 << 64))
+                points.append(_pt(1))
+            else:
+                scalars.append(rng.randrange(0, R))
+                points.append(
+                    bad_point if rng.random() < 0.5 else b"\xaa" * 48)
+            skip.append(1 if rng.random() < 0.25 else 0)
+        sb = _sblob(scalars)
+        pb = b"".join(points)
+        sk = bytes(skip)
+        got = native.g1_msm(sb, pb, n, skip=sk)
+        want = kzg.g1_msm_oracle(sb, pb, n, skip=sk)
+        assert got is not None
+        if want is None:
+            assert got is False
+        else:
+            assert got == want
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_msm_seam_dispatch_and_metrics():
+    """kzg.msm routes native-first and counts each dispatch; invalid
+    input raises through either path; _msm_or_none never raises."""
+    from cometbft_tpu.utils.metrics import crypto_metrics
+
+    cm = crypto_metrics()
+
+    def val(c):
+        return c.values().get((), 0.0)
+
+    n0 = val(cm.msm_native_total)
+    out = kzg.msm([2, 3], [_pt(1), _pt(2)])
+    assert out == _pt(8)
+    assert val(cm.msm_native_total) == n0 + 1
+    o0 = val(cm.msm_oracle_total)
+    assert kzg.msm([2, 3], [_pt(1), _pt(2)], force_oracle=True) == out
+    assert val(cm.msm_oracle_total) == o0 + 1
+    with pytest.raises(ValueError):
+        kzg.msm([1], [b"\xee" * 48])
+    with oracle_only():
+        with pytest.raises(ValueError):
+            kzg.msm([1], [b"\xee" * 48])
+        assert kzg.msm([2, 3], [_pt(1), _pt(2)]) == out
+    assert kzg._msm_or_none([1], [b"\xee" * 48]) is None
+    assert kzg._msm_or_none([2, 3], [_pt(1), _pt(2)]) == out
+
+
+# ------------------------------------------------ KZG commit/open/verify
+
+
+@pytest.fixture(scope="module")
+def poly():
+    coeffs = _det_scalars(12, b"poly")
+    srs = kzg.setup(len(coeffs))
+    return coeffs, kzg.commit(coeffs, srs), srs
+
+
+def test_open_verify_roundtrip(poly):
+    coeffs, c, srs = poly
+    for z in (0, 1, 7, 11, 12, 1 << 40):
+        y, pi = kzg.open_single(coeffs, z, srs)
+        assert y == kzg.poly_eval(coeffs, z)
+        assert kzg.verify(c, z, y, pi, srs)
+
+
+def test_verify_rejects_wrong_value_and_point(poly):
+    coeffs, c, srs = poly
+    y, pi = kzg.open_single(coeffs, 7, srs)
+    assert not kzg.verify(c, 7, (y + 1) % R, pi, srs)  # wrong value
+    assert not kzg.verify(c, 8, y, pi, srs)  # wrong point
+    assert not kzg.verify(_pt(9), 7, y, pi, srs)  # wrong commitment
+
+
+def test_verify_rejects_tampered_proof(poly):
+    coeffs, c, srs = poly
+    y, pi = kzg.open_single(coeffs, 7, srs)
+    # a DIFFERENT valid group element (proof for another point) — the
+    # pairing equation itself must fail, not just decoding
+    _, pi_other = kzg.open_single(coeffs, 8, srs)
+    assert pi_other != pi and not kzg.verify(c, 7, y, pi_other, srs)
+    assert not kzg.verify(c, 7, y, _pt(1), srs)
+    assert not kzg.verify(c, 7, y, bytes([pi[0] ^ 0x20]) + pi[1:], srs)
+    assert not kzg.verify(c, 7, y, b"\xee" * 48, srs)
+
+
+def test_verify_rejects_non_subgroup_and_identity(poly):
+    coeffs, c, srs = poly
+    y, pi = kzg.open_single(coeffs, 7, srs)
+    bad = _non_subgroup_point()
+    assert not kzg.verify(bad, 7, y, pi, srs)
+    assert not kzg.verify(c, 7, y, bad, srs)
+    # identity proof only verifies for a constant polynomial opening
+    assert not kzg.verify(c, 7, y, INF, srs)
+    const = [41]
+    c_const = kzg.commit(const, srs)
+    y_c, pi_c = kzg.open_single(const, 3, srs)
+    assert pi_c == INF and kzg.verify(c_const, 3, y_c, pi_c, srs)
+
+
+def test_verify_native_and_oracle_pairing_agree(poly):
+    """The pairing seam: the native two-GT comparison and the oracle
+    product-of-pairings return the same verdict on accept and reject."""
+    coeffs, c, srs = poly
+    y, pi = kzg.open_single(coeffs, 7, srs)
+    with mock.patch.object(native, "bls_pairing", lambda *a: None):
+        assert kzg.verify(c, 7, y, pi, srs)
+        assert not kzg.verify(c, 7, (y + 1) % R, pi, srs)
+
+
+# ------------------------------------------------ batched multiproofs
+
+
+@pytest.fixture(scope="module")
+def columns():
+    polys = [_det_scalars(9, b"col%d" % j) for j in range(5)]
+    srs = kzg.setup(9)
+    coms = [kzg.commit(cj, srs) for cj in polys]
+    return polys, coms, srs
+
+
+def test_multiproof_roundtrip_all_widths(columns):
+    polys, coms, srs = columns
+    for s in range(1, len(polys) + 1):
+        ys, proof = kzg.open_multi(polys[:s], coms[:s], 4, srs)
+        assert len(proof) == kzg.PROOF_SIZE
+        assert ys == [kzg.poly_eval(cj, 4) for cj in polys[:s]]
+        assert kzg.verify_multi(coms[:s], 4, ys, proof, srs)
+
+
+@pytest.mark.skipif(not HAVE_MSM, reason="native G1 MSM engine not built")
+def test_multiproof_native_oracle_bit_exact(columns):
+    """The folded quotient commitment is ONE MSM, so forcing the
+    oracle must reproduce the native proof byte-for-byte."""
+    polys, coms, srs = columns
+    ys_n, pi_n = kzg.open_multi(polys, coms, 6, srs)
+    ys_o, pi_o = kzg.open_multi(polys, coms, 6, srs, force_oracle=True)
+    assert ys_n == ys_o and pi_n == pi_o
+
+
+def test_multiproof_rejects_tampering(columns):
+    polys, coms, srs = columns
+    ys, proof = kzg.open_multi(polys, coms, 4, srs)
+    bad_ys = list(ys)
+    bad_ys[2] = (bad_ys[2] + 1) % R
+    assert not kzg.verify_multi(coms, 4, bad_ys, proof, srs)
+    # swapped commitments change the Fiat-Shamir fold
+    swapped = [coms[1], coms[0]] + coms[2:]
+    assert not kzg.verify_multi(swapped, 4, ys, proof, srs)
+    assert not kzg.verify_multi(coms, 5, ys, proof, srs)
+    assert not kzg.verify_multi(coms, 4, ys, _pt(3), srs)
+    assert not kzg.verify_multi(coms, 4, ys[:-1], proof, srs)
+    assert not kzg.verify_multi([], 4, [], proof, srs)
+    bad_com = coms[:-1] + [_non_subgroup_point()]
+    assert not kzg.verify_multi(bad_com, 4, ys, proof, srs)
+
+
+# ------------------------------------------------ 2D encoding + parity
+
+
+def test_pc_payload_roundtrip_tail_padding():
+    """Column-major grid embed/extract is exact, including payloads
+    whose tail chunk is shorter than 31 bytes (right-padded)."""
+    for n in (1, 30, 31, 32, 61, 311, 1000):
+        payload = bytes((7 * i + n) % 256 for i in range(n))
+        enc = pc.pc_encode(payload, 4, 4)
+        assert pc.decode_payload(enc) == payload
+        assert enc.com.payload_len == n
+        assert enc.com.k_r == pc.grid_rows(n, 4)
+        assert enc.com.n_r == 2 * enc.com.k_r
+
+
+def test_pc_row_extension_is_column_code():
+    """Rows k_r..n_r-1 evaluate the same column polynomial — every
+    cell matches a direct evaluation, and parity columns are the
+    Lagrange combination of the data columns cell-by-cell."""
+    enc = pc.pc_encode(bytes(range(200)), 4, 4)
+    com = enc.com
+    for j in range(com.n_c):
+        for i in range(com.n_r):
+            assert enc.cells[j][i] == kzg.poly_eval(enc.col_coeffs[j], i)
+    lam_rows = [kzg.lagrange_coeffs_at(list(range(com.k_c)), jp)
+                for jp in range(com.k_c, com.n_c)]
+    for t, jp in enumerate(range(com.k_c, com.n_c)):
+        for i in range(com.n_r):
+            want = sum(
+                lam_rows[t][j] * enc.cells[j][i] for j in range(com.k_c)
+            ) % R
+            assert enc.cells[jp][i] == want
+
+
+def test_parity_commitment_check_accept_and_reject():
+    enc = pc.pc_encode(b"parity-check-payload" * 9, 4, 4)
+    assert pc.verify_commitments(enc.com)
+    coms = list(enc.com.commitments)
+    coms[5] = _pt(1)  # one forged parity commitment
+    assert not kzg.verify_parity_commitments(coms, 4)
+    coms2 = list(enc.com.commitments)
+    coms2[0], coms2[1] = coms2[1], coms2[0]  # reordered data columns
+    assert not kzg.verify_parity_commitments(coms2, 4)
+    assert not kzg.verify_parity_commitments(coms[:4], 4)  # no parity
+    assert not kzg.verify_parity_commitments(coms, 0)
+
+
+def test_pc_sample_verify_roundtrip_and_rejects():
+    enc = pc.pc_encode(bytes(range(256)) * 2, 4, 4)
+    com = enc.com
+    root = com.root()
+    cols = [0, 3, 5, 7]
+    ys, proof = enc.open_row_cols(2, cols)
+    assert pc.verify_sample(com, root, 2, cols, ys, proof)
+    assert not pc.verify_sample(com, b"\x00" * 32, 2, cols, ys, proof)
+    assert not pc.verify_sample(com, root, 3, cols, ys, proof)
+    assert not pc.verify_sample(com, root, 2, [0, 3, 5, 6], ys, proof)
+    assert not pc.verify_sample(com, root, com.n_r, cols, ys, proof)
+    assert not pc.verify_sample(com, root, 2, [0, 3, 5, 99], ys, proof)
+    bad_ys = list(ys)
+    bad_ys[1] = (bad_ys[1] + 1) % R
+    assert not pc.verify_sample(com, root, 2, cols, bad_ys, proof)
+
+
+# ---------------------------- the pinned inconsistent-encoding pair
+
+
+def test_lying_encoder_2d_detected_despite_valid_openings():
+    """PINNED: a proposer committing HONESTLY to garbage parity
+    columns. Every multiproof opening verifies — and the once-per-
+    height parity-linearity check still catches it for every client."""
+    payload = b"lying-encoder-world" * 23
+    honest = pc.pc_encode(payload, 4, 4)
+    bad = pc.make_inconsistent(honest, seed=7)
+    com = bad.com
+    assert com.commitments[:4] == honest.com.commitments[:4]
+    assert com.commitments[4:] != honest.com.commitments[4:]
+    assert not pc.verify_commitments(com)
+
+    def fetch(height, row, cols):
+        return bad.open_row_cols(row, cols)
+
+    for cid in range(24):
+        s = PCSampler(cid, com.n_c, com.k_c, com.n_r, seed=3)
+        res = s.run(1, com.root(), com, fetch)
+        # the openings themselves are fine — detection is the parity
+        # check's alone, which is exactly the point
+        assert res.samples_ok == s.samples and res.samples_failed == 0
+        assert not res.commitments_ok
+        assert res.detected_withholding and not res.confident
+
+
+def test_lying_encoder_1d_provably_blind():
+    """PINNED counterpart: the same world on the 1D Merkle track —
+    garbage parity shards under an honest root. Every opening verifies
+    and every client stays fully confident; a hash commitment has no
+    linear structure for a consistency check to grip."""
+    payload = bytes(range(256)) * 4
+    data = split_payload(payload, 16)
+    garbage = [bytes((b + 1) % 256 for b in s) for s in data]
+    shards = data + garbage
+    com, proofs = commit_shards(shards, 16, len(payload))
+    for cid in range(24):
+        res = Sampler(client_id=cid, n=32, k=16, seed=3).run(
+            1, com.root(),
+            lambda h, idx: (shards[idx], proofs[idx], com))
+        assert res.confident and not res.detected_withholding
+        assert res.samples_failed == 0
+
+
+# ------------------------------------------------ sampler + serve
+
+
+def _pc_serve(k=4, m=4, k_c=4, m_c=4):
+    return DAServe(DAConfig(
+        enabled=True, data_shards=k, parity_shards=m, retain_heights=16,
+        pc=True, pc_data_cols=k_c, pc_parity_cols=m_c,
+    ))
+
+
+def test_pcsampler_draw_deterministic_and_distinct():
+    s1 = PCSampler(3, 8, 4, 20, seed=5)
+    s2 = PCSampler(3, 8, 4, 20, seed=5)
+    root = hashlib.sha256(b"draw").digest()
+    assert s1.draw(9, root) == s2.draw(9, root)
+    row, cols = s1.draw(9, root)
+    assert 0 <= row < 20
+    assert len(cols) == s1.samples == len(set(cols))
+    assert all(0 <= c < 8 for c in cols)
+    assert s1.draw(10, root) != s1.draw(9, root)
+    # samples clamp to the column count
+    assert PCSampler(0, 8, 4, 20, samples=99, seed=5).samples == 8
+
+
+def test_serve_pc_track_end_to_end():
+    srv = _pc_serve()
+    payload = bytes((i * 31) % 256 for i in range(700))
+    entry = srv.apply_payload(1, payload)
+    assert entry.pc is not None
+    com = srv.pc_commitments(1)
+    assert com is not None and pc.verify_commitments(com)
+    # the header root binds BOTH tracks through the combined root
+    assert entry.da_root == combined_root(
+        entry.commitment.root(), com.root())
+
+    def fetch(height, row, cols):
+        return srv.pc_sample(height, row, cols)
+
+    res = PCSampler(0, com.n_c, com.k_c, com.n_r, seed=1).run(
+        1, com.root(), com, fetch)
+    assert res.confident and res.commitments_ok
+    assert res.proof_bytes > 0 and res.commitment_bytes == com.num_bytes()
+    st = srv.stats()
+    assert st["pc_enabled"] and st["pc_samples_served"] >= 1
+    # out-of-range requests refuse rather than crash
+    assert srv.pc_sample(1, com.n_r, [0]) is None
+    assert srv.pc_sample(1, 0, [com.n_c]) is None
+    assert srv.pc_sample(1, 0, []) is None
+    assert srv.pc_sample(2, 0, [0]) is None
+
+
+def test_serve_pc_withholding_detected():
+    srv = _pc_serve()
+    srv.apply_payload(1, b"withhold-me" * 40)
+    com = srv.pc_commitments(1)
+    srv.set_pc_withholding(1, range(com.m_c + 1))
+
+    def fetch(height, row, cols):
+        return srv.pc_sample(height, row, cols)
+
+    for cid in range(16):
+        res = PCSampler(cid, com.n_c, com.k_c, com.n_r, seed=2).run(
+            1, com.root(), com, fetch)
+        # more columns withheld than remain: every draw hits one
+        assert res.detected_withholding and not res.confident
+        assert res.samples_failed > 0 and res.commitments_ok
+        assert all(c <= com.m_c for c in res.failed_cols)
+    srv.set_pc_withholding(1, ())
+    res = PCSampler(0, com.n_c, com.k_c, com.n_r, seed=2).run(
+        1, com.root(), com, fetch)
+    assert res.confident
+
+
+def test_serve_corrupt_pc_parity_roundtrip():
+    srv = _pc_serve()
+    entry = srv.apply_payload(1, b"corrupt-parity" * 31)
+    honest_root = srv.pc_commitments(1).root()
+    assert srv.corrupt_pc_parity(1, seed=11)
+    com = srv.pc_commitments(1)
+    assert com.root() != honest_root
+    assert not pc.verify_commitments(com)
+    # the corrupted world re-advertises a matching header root: the
+    # adversary commits to its garbage from the start
+    assert entry.da_root == combined_root(
+        entry.commitment.root(), com.root())
+    ys, proof = srv.pc_sample(1, 1, [0, 5])
+    assert pc.verify_sample(com, com.root(), 1, [0, 5], ys, proof)
+    assert not srv.corrupt_pc_parity(99)
+
+
+def test_pc_track_off_keeps_plain_1d_root():
+    srv = DAServe(DAConfig(
+        enabled=True, data_shards=4, parity_shards=4, retain_heights=8,
+    ))
+    entry = srv.apply_payload(1, b"plain-1d" * 20)
+    assert entry.pc is None
+    assert entry.da_root == entry.commitment.root()
+    assert srv.pc_commitments(1) is None
+    assert srv.pc_sample(1, 0, [0]) is None
+    assert not srv.stats()["pc_enabled"]
+
+
+def test_pc_wire_cost_beats_1d_bound():
+    """The headline economics, pinned at the default geometry: s
+    evaluations + ONE 48 B proof (+ the amortized commitment list)
+    stay under the 1D track's 256 B chunk+path floor."""
+    srv = _pc_serve()
+    srv.apply_payload(1, bytes(range(256)) * 4)
+    com = srv.pc_commitments(1)
+    s = PCSampler(0, com.n_c, com.k_c, com.n_r, seed=1)
+    per_sample = (pc.multiproof_num_bytes(s.samples) / s.samples
+                  + com.num_bytes() / s.samples)
+    assert per_sample < 256
+
+
+# ------------------------------------------------ RPC routes
+
+
+def test_da_pc_routes():
+    srv = _pc_serve()
+    srv.apply_payload(3, bytes((5 * i) % 256 for i in range(500)))
+    client = LocalClient(Env(da_serve=srv))
+    r = client.da_pc_commitments(height="3")
+    com = srv.pc_commitments(3)
+    assert r["cols"] == com.n_c and r["data_cols"] == com.k_c
+    assert r["rows"] == com.n_r and r["data_rows"] == com.k_r
+    assert int(r["payload_len"]) == com.payload_len
+    wire = pc.PCCommitment(
+        n_r=r["rows"], k_r=r["data_rows"], n_c=r["cols"],
+        k_c=r["data_cols"], payload_len=int(r["payload_len"]),
+        commitments=tuple(bytes.fromhex(c) for c in r["commitments"]),
+    )
+    assert wire.root().hex() == r["pc_root"].lower()
+    sr = client.da_pc_sample(height="3", row="1", cols="0,2,6")
+    ys = [int(y, 16) for y in sr["ys"]]
+    proof = bytes.fromhex(sr["proof"])
+    assert pc.verify_sample(wire, wire.root(), 1, [0, 2, 6], ys, proof)
+    with pytest.raises(RPCError):
+        client.da_pc_sample(height="3", row="999", cols="0")
+    with pytest.raises(RPCError):
+        client.da_pc_commitments(height="9")
+    with pytest.raises(RPCError):
+        client.da_pc_sample(height="3", row="1", cols="zz")
+
+
+def test_da_pc_routes_disabled_without_serve():
+    client = LocalClient(Env())
+    with pytest.raises(RPCError, match="disabled"):
+        client.da_pc_commitments(height="1")
+    with pytest.raises(RPCError, match="disabled"):
+        client.da_pc_sample(height="1", row="0", cols="0")

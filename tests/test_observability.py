@@ -557,6 +557,23 @@ def test_bench_compare_bls_advisory_never_gates():
     assert "bench_compare:" in p.stdout
 
 
+def test_bench_compare_pc_advisory_never_gates():
+    """tools/bench_compare.py --pc --advisory: the polynomial-
+    commitment DAS diff is informational in tier-1 — rc 0 whether the
+    das_pc record exists on both sides, one side, or regressed — and
+    the lying-encoder line always renders."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_compare.py"),
+         "--pc", "--advisory", "--threshold", "0.001"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    assert "das pc" in p.stdout
+    assert "bench_compare:" in p.stdout
+
+
 def test_bench_compare_city_advisory_never_gates():
     """tools/bench_compare.py --city --advisory: the city-combined
     workload diff (shared-scheduler coalesce factor first-class) is

@@ -12,7 +12,11 @@
 # aggregation chunk determinism, single cert pairing check), and the
 # GF(2^16) Reed-Solomon DA codec (parameter guards, insufficient
 # survivors, 4096-shard ceiling, threaded encode/reconstruct roundtrip
-# with chunk-count determinism).
+# with chunk-count determinism), and the G1 Pippenger MSM / KZG engine
+# (oracle-pinned commit/open/verify roundtrip closed with a native
+# pairing check, n==0, skip masks, identity points, zero scalars, the
+# max-bucket digit tier, chunk-count determinism, scalar >= r and
+# bad-encoding rejects).
 set -e
 cd "$(dirname "$0")/.."
 # -std=c++17: std::shared_mutex in the IFMA engine; g++ <= 10 defaults

@@ -410,6 +410,108 @@ def compare_das(ref: str, threshold: float,
     }
 
 
+def _pc_record(flat_src: str):
+    """The das_pc_* record from a WORKLOADS.json body, or None."""
+    data = _load(flat_src)
+    if isinstance(data, dict):
+        for key, rec in data.items():
+            if key.startswith("das_pc_") and isinstance(rec, dict):
+                return rec
+    return None
+
+
+# polarity the suffix heuristics would misread: per-sample wire bytes
+# and opening latencies are costs, openings-per-second and the native
+# speedup factor are wins
+_PC_DIRECTIONS = {
+    "honest.bytes_per_sample": "lower",
+    "openings.native_open_ms": "lower",
+    "openings.oracle_open_ms": "lower",
+    "openings.verify_ms": "lower",
+    "openings.native_openings_per_s": "higher",
+    "openings.oracle_openings_per_s": "higher",
+    "openings.native_speedup": "higher",
+    "oneD_blind_confident_fraction": "higher",
+}
+# noisy / non-measurement leaves: per-leg snapshots, run geometry,
+# wall-time-scaled counters
+_PC_SKIP = ("honest_legs.", "withholding.", "lying_encoder.", "gate.",
+            "http_", "heights_", "blocks_encoded", "pc_samples_served",
+            "pc_skipped_rows", "duration_s", "pc_data_cols",
+            "pc_parity_cols", "grid_rows", "honest.clients",
+            "honest.samples_total", "honest.clients_confident",
+            "rs_proof_bytes_bound", "openings.quotient_degree",
+            "openings.cols_per_opening", "openings.msm_threads")
+
+
+def compare_pc(ref: str, threshold: float,
+               relpath: str = "WORKLOADS.json") -> dict:
+    """Diff of the polynomial-commitment DAS workload (ISSUE 19):
+    multiproof wire cost, fleet throughput, and the native-vs-oracle
+    MSM opening rates go through the directional machinery (with
+    explicit polarity for the keys the suffix heuristics would
+    misread); the lying-encoder parity-fail fraction is first-class —
+    detection is deterministic, so anything below 1.0 is the
+    regression the adversarial leg exists to catch."""
+    cur_path = os.path.join(REPO, relpath)
+    if not os.path.exists(cur_path):
+        return {"file": relpath, "skipped": "no working-tree copy"}
+    base_text = _git_show(ref, relpath)
+    if base_text is None:
+        return {"file": relpath,
+                "skipped": f"no baseline at {ref} (or git unavailable)"}
+    with open(cur_path) as f:
+        cur = _pc_record(f.read())
+    base = _pc_record(base_text)
+    if cur is None or base is None:
+        return {"file": relpath,
+                "skipped": "no das_pc record on one side"}
+
+    b_flat, c_flat = _flatten(base), _flatten(cur)
+    rows = []
+    for key in sorted(c_flat):
+        if key not in b_flat or b_flat[key] == 0:
+            continue
+        if any(key.startswith(p) or p in key for p in _PC_SKIP):
+            continue
+        d = _PC_DIRECTIONS.get(key) or direction(key)
+        if d == "neutral":
+            continue
+        b, c = b_flat[key], c_flat[key]
+        if not isinstance(b, (int, float)) or isinstance(b, bool):
+            continue
+        rel = (c - b) / abs(b)
+        rows.append({
+            "key": key, "baseline": b, "current": c,
+            "change_pct": round(rel * 100, 1), "direction": d,
+            "worse": (rel > threshold if d == "lower"
+                      else rel < -threshold),
+            "better": (rel < -threshold if d == "lower"
+                       else rel > threshold),
+        })
+
+    def frac(rec):
+        lie = rec.get("lying_encoder") or {}
+        n = lie.get("clients") or 0
+        return (lie.get("clients_parity_fail", 0) / n) if n else None
+
+    b_f, c_f = frac(base), frac(cur)
+    detect = {"baseline": b_f, "current": c_f,
+              "worse": (b_f is not None and c_f is not None
+                        and c_f < b_f),
+              "better": False}
+    regs = [r for r in rows if r["worse"]]
+    if detect["worse"]:
+        regs.append({"key": "lying_encoder_parity_fail_frac", **detect})
+    return {
+        "file": relpath, "mode": "das_pc",
+        "lying_encoder_detect": detect,
+        "rows": rows,
+        "regressions": regs,
+        "improvements": [r for r in rows if r["better"]],
+    }
+
+
 def _city_record(flat_src: str):
     """The city_combined record from a WORKLOADS.json body, or None."""
     data = _load(flat_src)
@@ -851,6 +953,24 @@ def _print_das(rep: dict) -> None:
                  r["change_pct"], r["direction"]))
 
 
+def _print_pc(rep: dict) -> None:
+    if "skipped" in rep:
+        print(f"das pc: skipped ({rep['skipped']})")
+        return
+    d = rep["lying_encoder_detect"]
+    tag = "REGRESSION" if d["worse"] else "          "
+    b = f"{d['baseline']:.1%}" if d["baseline"] is not None else "n/a"
+    c = f"{d['current']:.1%}" if d["current"] is not None else "n/a"
+    print(f"das pc ({rep['file']}): {tag} lying encoder caught for "
+          f"{b} -> {c} of the fleet")
+    for r in rep["rows"]:
+        tag = ("REGRESSION" if r["worse"]
+               else "improved  " if r["better"] else "          ")
+        print("  %s %-32s %12g -> %-12g (%+.1f%%, %s-better)"
+              % (tag, r["key"], r["baseline"], r["current"],
+                 r["change_pct"], r["direction"]))
+
+
 def _print_bls(rep: dict) -> None:
     if "skipped" in rep:
         print(f"bls crossover: skipped ({rep['skipped']})")
@@ -906,6 +1026,10 @@ def main(argv=None) -> int:
                     help="also diff the data-availability sampling "
                          "workload (withholding detection fraction "
                          "first-class)")
+    ap.add_argument("--pc", action="store_true",
+                    help="also diff the polynomial-commitment DAS "
+                         "workload (lying-encoder parity-fail fraction "
+                         "first-class)")
     ap.add_argument("--city", action="store_true",
                     help="also diff the city-scale combined workload "
                          "(shared-scheduler coalesce factor first-class)")
@@ -940,6 +1064,8 @@ def main(argv=None) -> int:
                if args.bls else None)
     das_rep = (compare_das(args.ref, args.threshold)
                if args.das else None)
+    pc_rep = (compare_pc(args.ref, args.threshold)
+              if args.pc else None)
     city_rep = (compare_city(args.ref, args.threshold)
                 if args.city else None)
     repl_rep = (compare_replicated(args.ref, args.threshold)
@@ -949,8 +1075,8 @@ def main(argv=None) -> int:
     wt_rep = (compare_watchtower(args.ref, args.threshold)
               if args.watchtower else None)
     n_reg = sum(len(r.get("regressions", ())) for r in reports)
-    for extra in (ingest_rep, bls_rep, das_rep, city_rep, repl_rep,
-                  cert_rep, wt_rep):
+    for extra in (ingest_rep, bls_rep, das_rep, pc_rep, city_rep,
+                  repl_rep, cert_rep, wt_rep):
         if extra is not None:
             n_reg += len(extra.get("regressions", ()))
     summary = {"ref": args.ref, "threshold": args.threshold,
@@ -962,6 +1088,8 @@ def main(argv=None) -> int:
         summary["bls_crossover"] = bls_rep
     if das_rep is not None:
         summary["das_sampling"] = das_rep
+    if pc_rep is not None:
+        summary["das_pc"] = pc_rep
     if city_rep is not None:
         summary["city_combined"] = city_rep
     if repl_rep is not None:
@@ -995,6 +1123,8 @@ def main(argv=None) -> int:
             _print_bls(bls_rep)
         if das_rep is not None:
             _print_das(das_rep)
+        if pc_rep is not None:
+            _print_pc(pc_rep)
         if city_rep is not None:
             _print_city(city_rep)
         if repl_rep is not None:

@@ -15,7 +15,10 @@ Each metric prints one JSON line; all are written to WORKLOADS.json.
 Separate flags run the heavier subsystem workloads on their own:
 --ingest, --light (10k-subscriber /light_stream fan-out), --bls
 (aggregate-signature certificate track), --das (data-availability
-sampling fleet + withholding leg), --certnative (certificate-native
+sampling fleet + withholding leg), --das --pc (the 2D
+polynomial-commitment DAS track: KZG multiproof fleet, lying-encoder
+and 1D-blindness legs, native MSM opening bench), --certnative
+(certificate-native
 wire/store/feed byte gates + one-pairing replay vs the
 fold-after-the-fact column baseline), --city (four concurrent legs),
 --city --replicas N (the scale-out serving plane: N stateless replica
@@ -1545,6 +1548,147 @@ def bench_das_fleet(clients=1000, duration_s=8.0, k=16, m=16,
     return rec
 
 
+def bench_das_pc(clients=1000, duration_s=6.0, k_c=4, m_c=4,
+                 http_samples=4):
+    """Polynomial-commitment DAS workload (ROADMAP items #1/#4, ISSUE
+    19): tools/dasload.py --pc boots one validator with the 2D KZG
+    track enabled and drives `clients` sampling clients per committed
+    block, then runs three adversarial legs (column withholding, a
+    lying encoder with honestly-committed garbage parity, and the same
+    lying encoder on the 1D Merkle track) plus a native-vs-oracle
+    multiproof opening comparison on the Pippenger MSM engine.
+
+    Two gate classes:
+
+    - asserted EVERYWHERE (protocol correctness + wire cost, not host
+      speed): every honest client reaches 99% confidence, multiproof
+      bytes/sample (INCLUDING the amortized commitment download) beat
+      the 1D track's 256 B chunk+path bound, every client detects
+      m_c+1 withheld columns (deterministic: more columns are withheld
+      than remain), the parity-linearity check catches the lying
+      encoder for EVERY client while the 1D fleet stays fully
+      confident over the same corruption (the pinned blindness pair),
+      the committed header's da_root binds the PC commitment via the
+      combined root, the HTTP multiproof path verifies client-side,
+      and the native MSM opening path is available and faster than the
+      pure-Python oracle (same-host A/B, robust to starvation);
+    - machine-gated on >=2 cores: absolute fleet sample throughput and
+      native openings/s (the fleet, the MSM worker pool, and consensus
+      time-share the core on a starved host).
+    """
+    import subprocess
+
+    n_clients = 200 if QUICK else clients
+    dur = 3.0 if QUICK else duration_s
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dasload.py")
+    p = subprocess.run(
+        [sys.executable, script, "--pc", "--clients", str(n_clients),
+         "--duration", str(dur), "--pc-data-cols", str(k_c),
+         "--pc-parity-cols", str(m_c),
+         "--http-samples", str(http_samples)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"dasload --pc rc={p.returncode}\n"
+            f"stderr: {p.stderr[-2000:]}")
+    rec = None
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if rec is None:
+        raise RuntimeError(
+            f"dasload --pc produced no JSON: {p.stdout[-500:]}")
+    hon, adv, lie = rec["honest"], rec["withholding"], rec["lying_encoder"]
+    opens = rec["openings"]
+    print(f"  das pc: {hon['clients']} clients x "
+          f"{hon['heights_sampled']} heights, "
+          f"{hon['samples_per_sec']} samples/s, "
+          f"{hon['bytes_per_sample']} B/sample vs {rec['rs_proof_bytes_bound']} B "
+          f"1D bound, lying encoder caught {lie['clients_parity_fail']}"
+          f"/{lie['clients']}, native open "
+          f"{opens.get('native_speedup', 'n/a')}x oracle",
+          file=sys.stderr)
+
+    # --- correctness gates: asserted unconditionally -------------------
+    assert hon["heights_sampled"] > 0 and rec["blocks_encoded"] > 0, (
+        "no blocks PC-encoded/sampled under the fleet")
+    assert hon["clients_confident_min"] == hon["clients"], (
+        f"only {hon['clients_confident_min']}/{hon['clients']} clients "
+        "reached 99% confidence on a fully-available block")
+    assert hon["bytes_per_sample"] < rec["rs_proof_bytes_bound"], (
+        f"multiproof wire cost {hon['bytes_per_sample']} B/sample "
+        f"(incl. commitments) does not beat the 1D "
+        f"{rec['rs_proof_bytes_bound']} B bound")
+    assert adv["clients_detected"] == adv["clients"], (
+        f"only {adv['clients_detected']}/{adv['clients']} clients "
+        f"detected {adv['withheld_cols']} withheld columns")
+    assert (lie["clients_parity_fail"] == lie["clients"]
+            and lie["clients_confident"] == 0), (
+        f"lying encoder survived: {lie['clients_parity_fail']}"
+        f"/{lie['clients']} parity failures, "
+        f"{lie['clients_confident']} clients confident")
+    assert lie["samples_ok"] == lie["samples"], (
+        "lying-encoder openings should all VERIFY (the whole point: "
+        f"only the linearity check catches it) — "
+        f"{lie['samples_ok']}/{lie['samples']} ok")
+    assert rec["oneD_blind_confident_fraction"] == 1.0, (
+        "the 1D track detected honest-root garbage parity it is "
+        "supposed to be blind to — blindness demo broken: "
+        f"{rec['oneD_blind_confident_fraction']}")
+    assert rec["header_root_binds_pc"], (
+        "committed header da_root does not bind the PC commitment root")
+    assert (rec["http_samples_ok"] == rec["http_samples"]
+            and not rec["http_errors"]), (
+        f"HTTP da_pc_sample path failed: {rec['http_errors']}")
+    assert opens["native_available"], "native G1 MSM engine not built"
+    assert opens["native_speedup"] > 1.0, (
+        f"native multiproof opening only {opens['native_speedup']}x "
+        "the pure-Python oracle (expected > 1x on any host)")
+
+    # --- throughput gates: machine-gated -------------------------------
+    gate = {
+        "all_clients_confident": True,
+        "bytes_per_sample_beats_1d_bound": True,
+        "withholding_detected_by_all": True,
+        "lying_encoder_caught_by_all": True,
+        "oneD_track_blind": True,
+        "header_root_binds_pc": True,
+        "http_samples_verified": True,
+        "native_open_faster_than_oracle": True,
+        "min_samples_per_sec": 500.0,
+        "min_native_openings_per_sec": 50.0,
+    }
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        gate["asserted"] = False
+        gate["reason"] = (
+            f"starved host: {cores} core(s) — the sampling fleet, the "
+            "MSM worker pool, and consensus time-share the core, so "
+            "absolute throughput thresholds would gate on scheduler "
+            "interleaving; correctness and wire-cost gates above "
+            "asserted anyway. "
+            "Re-run `python tools/workloads.py --das --pc` on a "
+            ">=2-core host"
+        )
+    else:
+        gate["asserted"] = True
+        assert hon["samples_per_sec"] >= gate["min_samples_per_sec"], (
+            f"{hon['samples_per_sec']} samples/s < "
+            f"{gate['min_samples_per_sec']}")
+        assert (opens["native_openings_per_s"]
+                >= gate["min_native_openings_per_sec"]), (
+            f"{opens['native_openings_per_s']} native openings/s < "
+            f"{gate['min_native_openings_per_sec']}")
+    rec["gate"] = gate
+    return rec
+
+
 def _city_coalescing_leg(heights=4):
     """Deterministic half of the city coalescing measurement: the same
     mixed 3-tenant x 4-source request stream dispatched (a) one verify
@@ -2376,7 +2520,7 @@ def main():
         _merge_workloads([rec])
         return
     if "--das" in sys.argv:
-        rec = bench_das_fleet()
+        rec = bench_das_pc() if "--pc" in sys.argv else bench_das_fleet()
         _emit(rec)
         _merge_workloads([rec])
         return
